@@ -1,0 +1,68 @@
+"""Headline benchmark for the driver: prints ONE JSON line.
+
+Measures framework gemm throughput on the available accelerator (BASELINE.md
+config #1 family).  Baseline: the reference's only in-repo absolute number —
+dgemm n=10000, 4 ranks x 1 GPU, 0.712 s (docs/usage.md:41-42) = 2*n^3/t/4 ≈
+702 GFLOP/s per GPU.  We report GFLOP/s per chip for the framework's gemm at
+n=4096 (f32 — TPU v5e has no native f64; the mixed-precision solvers are the
+f64-accuracy path, see slate_tpu/drivers/mixed.py).
+
+Timing: the remote-tunnel platform makes block_until_ready a no-op and a
+host fetch costs ~70 ms round trip, so we chain ``iters`` dependent gemms
+inside one jitted scan and fetch one element — the round trip is amortised
+and each step truly depends on the previous (no dead-code elimination).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+import slate_tpu as st
+
+BASELINE_GFLOPS_PER_CHIP = 702.0  # ref docs/usage.md:41-42, per-GPU dgemm
+
+
+def bench_gemm(n=4096, nb=256, iters=50, reps=3):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+
+    def chained(A, B):
+        def body(carry, _):
+            C = st.gemm(1.0 / n, A, st.Matrix(st.TileStorage(
+                carry, B.storage.m, B.storage.n, B.storage.mb,
+                B.storage.nb, B.storage.grid)))
+            return C.storage.data, None
+        out, _ = lax.scan(body, B.storage.data, None, length=iters)
+        return out
+
+    run = jax.jit(chained)
+    np.asarray(jax.device_get(run(A, B)[0, 0, 0, 0]))  # compile + warmup
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(run(A, B)[0, 0, 0, 0]))
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+    return 2.0 * n * n * n * iters / t / 1e9
+
+
+def main():
+    gflops = bench_gemm()
+    print(json.dumps({
+        "metric": "gemm_n4096_gflops_per_chip",
+        "value": round(gflops, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / BASELINE_GFLOPS_PER_CHIP, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""slate_tpu — TPU-native distributed dense linear algebra.
+
+A from-scratch framework with the capabilities of the reference SLATE library
+(distributed tiled BLAS-3, linear solvers, least squares, eigensolvers, SVD;
+ref: /root/reference README.md:15-37), re-designed for TPU:
+
+- tiles live as one blocked, 2D-block-cyclic-sharded array per matrix in HBM,
+- drivers compile to single XLA programs (jit) with MXU-shaped contractions,
+- the distributed backend is jax.shard_map + ICI collectives over a
+  ``jax.sharding.Mesh`` process grid,
+- mixed-precision (f32 factor + f64 refine) is the native high-precision path.
+"""
+
+from .version import __version__, id, version  # noqa: F401
+from .types import Diag, Layout, Norm, Op, Side, TileKind, Uplo  # noqa: F401
+from .options import (  # noqa: F401
+    GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm, MethodHemm,
+    MethodLU, MethodTrsm, NormScope, Option, Target,
+)
+from .exceptions import (  # noqa: F401
+    SlateError, SlateNotConvergedError, SlateNotPositiveDefiniteError,
+    SlateValueError,
+)
+from .core.grid import Grid, make_grid  # noqa: F401
+from .core.storage import TileStorage  # noqa: F401
+from .core.matrix import (  # noqa: F401
+    BandMatrix, BaseBandMatrix, BaseMatrix, BaseTrapezoidMatrix,
+    HermitianBandMatrix, HermitianMatrix, Matrix, SymmetricMatrix,
+    TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
+)
+from .drivers.blas3 import gemm, gemmA, gemmC  # noqa: F401

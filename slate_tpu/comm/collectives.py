@@ -1,0 +1,115 @@
+"""Mesh collectives: the TPU-native communication backend.
+
+Analog of the reference's MPI tile-communication layer (ref:
+include/slate/BaseMatrix.hh:1923-2492 listBcast/listBcastMT/listReduce,
+src/internal/internal_comm.cc:17-123 hypercube patterns + subcommunicators,
+src/stubs/mpi_stubs.cc serial fallback).
+
+Mapping:
+
+- ``BcastList`` — "broadcast tile (i, k) to the ranks owning row i / col j"
+  (BaseMatrix.hh:42-55) — becomes :func:`bcast_along` : a masked ``psum`` (or
+  one-hot ``all_gather`` pick) along a mesh axis, executed inside shard_map.
+  The root is *data-dependent* (owner column k % q), which MPI expresses with
+  per-tile point-to-point trees and XLA expresses with a single collective
+  whose contribution is masked to the owner.  On TPU ICI the collective IS a
+  near-optimal ring/tree — the hand-built radix-4 hypercube of
+  ``listBcastMT`` (BaseMatrix.hh:2073-2174) is what XLA emits natively.
+- ``ReduceList`` (BaseMatrix.hh:2180-2217) becomes :func:`reduce_along` — a
+  ``psum`` whose result only the root keeps (others discard), or a full psum
+  when every rank wants the sum.
+- Panel subcommunicators (internal_comm.cc:17-48, used by the LU panel's
+  MAXLOC allreduce, Tile_getrf.hh:260-315) become reductions along ONE mesh
+  axis: the set "ranks owning tiles of panel column k" is exactly mesh column
+  k % q, so `commFromSet` degenerates to choosing the axis name.
+- MPI_MAXLOC becomes :func:`pargmax`: an argmax carried through psum via
+  (value, index) packing.
+- The serial stubs (src/stubs/) correspond to ``Grid(1, 1)``: all functions
+  here are only ever traced inside shard_map, and single-target drivers never
+  call them.
+
+Workspace life counters (receive-and-release, MatrixStorage.hh:1274-1283)
+have no analog: a broadcast value is an SSA temporary whose buffer XLA frees
+after its last use in the step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.grid import AXIS_P, AXIS_Q
+
+
+def my_coords():
+    """This shard's (p, q) coordinate — only valid inside shard_map."""
+    return lax.axis_index(AXIS_P), lax.axis_index(AXIS_Q)
+
+
+def bcast_along(x, root, axis: str):
+    """Broadcast ``x`` from the shard at index ``root`` along mesh ``axis``.
+
+    ``root`` may be a traced value (e.g. ``k % q`` inside a fori_loop) — the
+    data-dependent-root case that forces the reference to build explicit
+    rank lists (BaseMatrix.hh:2365-2427 tileIbcastToSet).  Implemented as a
+    masked psum: zeros are contributed by non-roots, so the sum is exactly
+    the root's value.
+    """
+    me = lax.axis_index(axis)
+    contrib = jnp.where(me == root, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis)
+
+
+def bcast_from_col(x, root_col):
+    """Broadcast along the q axis: tile column owners -> whole mesh row
+    (ref: A.listBcast of A(i, k) to owners of C(i, :), gemmC.cc:83-115)."""
+    return bcast_along(x, root_col, AXIS_Q)
+
+
+def bcast_from_row(x, root_row):
+    """Broadcast along the p axis: tile row owners -> whole mesh column."""
+    return bcast_along(x, root_row, AXIS_P)
+
+
+def reduce_along(x, axis: str):
+    """Sum-reduce along a mesh axis, result replicated (ReduceList analog,
+    BaseMatrix.hh:2180-2217; accumulate via tile::add ≙ psum)."""
+    return lax.psum(x, axis)
+
+
+def reduce_scatter_along(x, axis: str, tiled_axis: int = 0):
+    """Scatter-reduce along a mesh axis (ICI-efficient ReduceList when each
+    rank only needs its own slice of the sum)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=tiled_axis,
+                            tiled=True)
+
+
+def allgather_along(x, axis: str, concat_axis: int = 0):
+    return lax.all_gather(x, axis, axis=concat_axis, tiled=True)
+
+
+def pargmax(value, index, axis: str):
+    """MPI_Allreduce(MAXLOC) analog (ref: Tile_getrf.hh:260-262).
+
+    value: per-shard candidate magnitudes [...], index: their global indices.
+    Returns (max value, index of max) replicated along ``axis``; ties resolve
+    to the lowest index, matching MAXLOC.
+    """
+    vals = lax.all_gather(value, axis)          # [n_axis, ...]
+    idxs = lax.all_gather(index, axis)
+    flat_arg = jnp.argmax(vals, axis=0)
+    best_val = jnp.take_along_axis(vals, flat_arg[None], axis=0)[0]
+    # tie-break: among shards achieving best_val pick smallest index
+    is_best = vals == best_val[None]
+    big = jnp.iinfo(jnp.int32).max
+    cand = jnp.where(is_best, idxs, big)
+    best_idx = jnp.min(cand, axis=0)
+    return best_val, best_idx
+
+
+def ppermute_shift(x, axis: str, shift: int, size: int):
+    """Cyclic shift along a mesh axis (ref: pipeline/ring patterns;
+    lax.ppermute is the ICI point-to-point primitive)."""
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return lax.ppermute(x, axis, perm)

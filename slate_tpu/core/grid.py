@@ -1,0 +1,115 @@
+"""Process grid: 2D block-cyclic tile->device mapping over a jax Mesh.
+
+TPU-native analog of the reference's process-grid layer:
+
+- 2D block-cyclic tile->rank map over a p*q grid with Col/Row major rank
+  ordering (ref: include/slate/internal/MatrixStorage.hh:555-568,
+  include/slate/BaseMatrix.hh:885-915, enums.hh:127-131 GridOrder).
+- The reference separates MPI rank (inter-node) from device id (intra-node,
+  1D col-block-cyclic, MatrixStorage.hh:575-586).  On TPU there is one level:
+  each mesh coordinate (r, c) IS a chip, and collectives ride ICI along the
+  mesh axes, so the two maps collapse into one.
+
+The grid also owns the functional analog of the reference's per-device queue
+set (MatrixStorage.hh:651-667 initQueues): on TPU, XLA's async dispatch plus
+program-order scheduling replace explicit comm/compute queues; overlap is
+obtained by issuing independent computations, not by managing streams.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..exceptions import slate_error
+from ..options import GridOrder
+
+# Mesh axis names used throughout the framework.  'p' indexes process-grid
+# rows, 'q' process-grid columns (ref: p x q grid in BaseMatrix.hh:885).
+AXIS_P = "p"
+AXIS_Q = "q"
+
+
+class Grid:
+    """A p*q process grid backed by a ``jax.sharding.Mesh``.
+
+    ``Grid(1, 1)`` is the serial fallback: no mesh, all data on the default
+    device — the analog of the reference's MPI stubs build
+    (ref: src/stubs/mpi_stubs.cc) in which every collective degenerates to a
+    self-copy.
+    """
+
+    def __init__(self, p: int = 1, q: int = 1, *,
+                 devices: Sequence[jax.Device] | None = None,
+                 order: GridOrder = GridOrder.Col):
+        slate_error(p >= 1 and q >= 1, "grid dims must be >= 1")
+        self.p = p
+        self.q = q
+        self.order = order
+        self.size = p * q
+        if self.size == 1 and devices is None:
+            self.mesh = None
+            return
+        if devices is None:
+            devices = jax.devices()
+        slate_error(len(devices) >= p * q,
+                    f"need {p * q} devices, have {len(devices)}")
+        devs = np.asarray(devices[: p * q], dtype=object)
+        if order is GridOrder.Col:
+            # rank = r + c*p  -> device array indexed [r, c]
+            arr = devs.reshape(q, p).T
+        else:
+            arr = devs.reshape(p, q)
+        self.mesh = Mesh(arr, (AXIS_P, AXIS_Q))
+
+    # ---- tile -> coordinate maps (ref: MatrixStorage.hh:555-568) ----
+
+    def tile_coords(self, i: int, j: int) -> tuple[int, int]:
+        """2D block-cyclic owner coordinate of tile (i, j)."""
+        return (i % self.p, j % self.q)
+
+    def tile_rank(self, i: int, j: int) -> int:
+        """Linear rank of tile (i, j)'s owner under this grid's GridOrder."""
+        r, c = self.tile_coords(i, j)
+        return r + c * self.p if self.order is GridOrder.Col else r * self.q + c
+
+    def tile_device(self, i: int, j: int) -> jax.Device | None:
+        """Owning jax device (ref: tileDevice lambda, MatrixStorage.hh:575)."""
+        if self.mesh is None:
+            return None
+        r, c = self.tile_coords(i, j)
+        return self.mesh.devices[r, c]
+
+    # ---- shardings ----
+
+    def tile_sharding(self) -> NamedSharding | None:
+        """Sharding for cyclic-ordered tile storage [p*mtl, q*ntl, mb, nb]."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(AXIS_P, AXIS_Q, None, None))
+
+    def replicated_sharding(self) -> NamedSharding | None:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P())
+
+    def __repr__(self):
+        return f"Grid(p={self.p}, q={self.q}, order={self.order.value})"
+
+
+def make_grid(n_devices: int | None = None, *,
+              devices: Sequence[jax.Device] | None = None) -> Grid:
+    """Pick a near-square p*q factorisation of the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = n_devices if n_devices is not None else len(devices)
+    if n == 1:
+        return Grid(1, 1)
+    p = int(math.sqrt(n))
+    while n % p != 0:
+        p -= 1
+    return Grid(p, n // p, devices=devices)

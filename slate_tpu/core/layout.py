@@ -1,0 +1,81 @@
+"""Dense <-> blocked-tile layout conversion.
+
+TPU-native analog of the reference's layout machinery (ref:
+include/slate/Tile.hh:645-792 layoutConvert / makeTransposable and the
+fromLAPACK/fromScaLAPACK import paths, Matrix.hh:58-163).  The reference
+converts each tile between col/row-major in place; on TPU the whole matrix is
+one blocked array ``[Mt, Nt, mb, nb]`` and conversion is a single reshape +
+transpose that XLA fuses into surrounding code (free under jit).
+
+Padding discipline: partial boundary tiles are zero-padded.  Every kernel in
+the framework preserves "pad region == 0" as an invariant so reductions can
+run unmasked wherever zeros are absorbing; norms use explicit masks
+(ops/norms.py) where they are not.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def num_tiles(m: int, mb: int) -> int:
+    return -(-m // mb)
+
+
+def tile_dense(dense, mb: int, nb: int):
+    """[m, n] -> canonical tile array [Mt, Nt, mb, nb], zero-padded."""
+    m, n = dense.shape
+    Mt, Nt = num_tiles(m, mb), num_tiles(n, nb)
+    pad_m, pad_n = Mt * mb - m, Nt * nb - n
+    if pad_m or pad_n:
+        dense = jnp.pad(dense, ((0, pad_m), (0, pad_n)))
+    return dense.reshape(Mt, mb, Nt, nb).transpose(0, 2, 1, 3)
+
+
+def untile_dense(tiles, m: int, n: int):
+    """Canonical tile array [Mt, Nt, mb, nb] -> dense [m, n]."""
+    Mt, Nt, mb, nb = tiles.shape
+    dense = tiles.transpose(0, 2, 1, 3).reshape(Mt * mb, Nt * nb)
+    return dense[:m, :n]
+
+
+def cyclic_row_maps(Mt: int, p: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """Index maps between canonical tile order and 2D block-cyclic storage.
+
+    Storage row ``s`` of the sharded store holds canonical tile-row
+    ``i = (s % mtl) * p + (s // mtl)`` — i.e. device-row ``r = s // mtl`` owns
+    tiles ``i ≡ r (mod p)`` (ref: MatrixStorage.hh:555-568 2D block-cyclic).
+
+    Returns (c2s, s2c, mtl):
+      c2s[i] = storage row of canonical tile-row i          (len Mt)
+      s2c[s] = canonical tile-row of storage row s, or Mt for padding slots
+               (len p*mtl; index Mt addresses an all-zero pad tile)
+    """
+    mtl = -(-Mt // p)
+    c2s = np.empty(Mt, dtype=np.int32)
+    s2c = np.full(p * mtl, Mt, dtype=np.int32)
+    for i in range(Mt):
+        s = (i % p) * mtl + i // p
+        c2s[i] = s
+        s2c[s] = i
+    return c2s, s2c, mtl
+
+
+def canonical_to_cyclic(tiles, p: int, q: int):
+    """[Mt, Nt, mb, nb] canonical -> [p*mtl, q*ntl, mb, nb] cyclic storage."""
+    Mt, Nt, mb, nb = tiles.shape
+    _, s2c_r, _ = cyclic_row_maps(Mt, p)
+    _, s2c_c, _ = cyclic_row_maps(Nt, q)
+    # Append one zero pad-slot per axis, then gather with the s2c maps.
+    ext = jnp.concatenate([tiles, jnp.zeros((1, Nt, mb, nb), tiles.dtype)], 0)
+    ext = jnp.concatenate(
+        [ext, jnp.zeros((Mt + 1, 1, mb, nb), tiles.dtype)], 1)
+    return ext[s2c_r][:, s2c_c]
+
+
+def cyclic_to_canonical(data, Mt: int, Nt: int, p: int, q: int):
+    """[p*mtl, q*ntl, mb, nb] cyclic storage -> [Mt, Nt, mb, nb] canonical."""
+    c2s_r, _, _ = cyclic_row_maps(Mt, p)
+    c2s_c, _, _ = cyclic_row_maps(Nt, q)
+    return data[c2s_r][:, c2s_c]

@@ -1,0 +1,158 @@
+"""TileStorage: the distributed tile map as one sharded blocked array.
+
+TPU-native analog of the reference's storage & coherency layer
+(ref: include/slate/internal/MatrixStorage.hh:284-529 `MatrixStorage`,
+Memory.hh:29-95 block pool, MatrixStorage.hh:33-148 MOSI states):
+
+- The reference keeps a hash map {(i, j) -> TileNode} with one TileInstance
+  per device plus MOSI (Modified/Shared/Invalid/OnHold) coherency because a
+  tile may be replicated across host + several GPUs.  On TPU there is a single
+  memory space per chip and XLA owns buffer lifetimes, so the map becomes ONE
+  dense blocked array ``[p*mtl, q*ntl, mb, nb]`` in cyclic order, sharded over
+  the mesh so device (r, c) holds exactly its 2D-block-cyclic tiles
+  ``{(i, j) : i ≡ r (mod p), j ≡ c (mod q)}`` in HBM.  MOSI is unnecessary:
+  functional arrays cannot alias-stale, which is the whole problem MOSI solves.
+- The reference's `Memory` pool (per-device stacks of mb*nb blocks) maps to
+  XLA's arena allocator: tiles of one matrix are a single contiguous HBM
+  buffer, the strongest form of pooling.  Workspace "life" counters
+  (MatrixStorage.hh:1274-1283 tileTick) map to SSA value lifetimes inside the
+  compiled program — a broadcast panel dies when its last consumer retires,
+  which XLA computes exactly rather than by reference counting.
+- tileMb/tileNb/tileRank/tileDevice distribution lambdas
+  (MatrixStorage.hh:533-586) are `tile_mb`/`tile_nb` here plus Grid's maps.
+
+Storage is a registered pytree so matrices flow through jit/shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..exceptions import slate_error
+from . import layout
+from .grid import Grid
+
+
+@jax.tree_util.register_pytree_node_class
+class TileStorage:
+    """Tiles of an m*n matrix in 2D block-cyclic order on a p*q grid.
+
+    data[s, t] holds tile (i, j) with i = (s % mtl)*p + s//mtl,
+    j = (t % ntl)*q + t//ntl; rows s // mtl == r live on mesh row r.
+    """
+
+    def __init__(self, data, m: int, n: int, mb: int, nb: int, grid: Grid):
+        self.data = data
+        self.m, self.n = int(m), int(n)
+        self.mb, self.nb = int(mb), int(nb)
+        self.grid = grid
+        self.Mt = layout.num_tiles(self.m, self.mb)
+        self.Nt = layout.num_tiles(self.n, self.nb)
+        self.mtl = -(-self.Mt // grid.p)
+        self.ntl = -(-self.Nt // grid.q)
+
+    # ---- pytree ----
+    def tree_flatten(self):
+        return (self.data,), (self.m, self.n, self.mb, self.nb, self.grid)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        m, n, mb, nb, grid = aux
+        return cls(children[0], m, n, mb, nb, grid)
+
+    # ---- constructors ----
+    @classmethod
+    def zeros(cls, m, n, mb, nb, grid: Grid | None = None, dtype=jnp.float32):
+        grid = grid or Grid(1, 1)
+        Mt, Nt = layout.num_tiles(m, mb), layout.num_tiles(n, nb)
+        mtl, ntl = -(-Mt // grid.p), -(-Nt // grid.q)
+        data = jnp.zeros((grid.p * mtl, grid.q * ntl, mb, nb), dtype)
+        st = cls(data, m, n, mb, nb, grid)
+        return st._shard()
+
+    @classmethod
+    def from_dense(cls, dense, mb, nb, grid: Grid | None = None):
+        """Import a host/global array (ref: Matrix::fromLAPACK, Matrix.hh:344)."""
+        grid = grid or Grid(1, 1)
+        dense = jnp.asarray(dense)
+        slate_error(dense.ndim == 2, "from_dense needs a 2D array")
+        tiles = layout.tile_dense(dense, mb, nb)
+        data = layout.canonical_to_cyclic(tiles, grid.p, grid.q)
+        st = cls(data, dense.shape[0], dense.shape[1], mb, nb, grid)
+        return st._shard()
+
+    @classmethod
+    def from_canonical(cls, tiles, m, n, grid: Grid | None = None):
+        grid = grid or Grid(1, 1)
+        Mt, Nt, mb, nb = tiles.shape
+        slate_error(Mt == layout.num_tiles(m, mb) and
+                    Nt == layout.num_tiles(n, nb), "tile grid mismatch")
+        data = layout.canonical_to_cyclic(tiles, grid.p, grid.q)
+        st = cls(data, m, n, mb, nb, grid)
+        return st._shard()
+
+    def _shard(self) -> "TileStorage":
+        sh = self.grid.tile_sharding()
+        if sh is not None:
+            self.data = jax.device_put(self.data, sh)
+        return self
+
+    # ---- distribution lambdas (ref: MatrixStorage.hh:533-586) ----
+    def tile_mb(self, i: int) -> int:
+        """Rows in tile-row i (last tile may be partial)."""
+        return self.mb if i < self.Mt - 1 else self.m - (self.Mt - 1) * self.mb
+
+    def tile_nb(self, j: int) -> int:
+        return self.nb if j < self.Nt - 1 else self.n - (self.Nt - 1) * self.nb
+
+    def tile_rank(self, i: int, j: int) -> int:
+        return self.grid.tile_rank(i, j)
+
+    def tile_device(self, i: int, j: int):
+        return self.grid.tile_device(i, j)
+
+    # ---- views of the store ----
+    def canonical(self):
+        """Tiles in natural (i, j) order: [Mt, Nt, mb, nb]."""
+        return layout.cyclic_to_canonical(
+            self.data, self.Mt, self.Nt, self.grid.p, self.grid.q)
+
+    def to_dense(self):
+        return layout.untile_dense(self.canonical(), self.m, self.n)
+
+    def with_canonical(self, tiles) -> "TileStorage":
+        data = layout.canonical_to_cyclic(tiles, self.grid.p, self.grid.q)
+        st = TileStorage(data, self.m, self.n, self.mb, self.nb, self.grid)
+        return st._shard()
+
+    def with_dense(self, dense) -> "TileStorage":
+        return TileStorage.from_dense(dense, self.mb, self.nb, self.grid)
+
+    def tile(self, i: int, j: int):
+        """Fetch one tile (debug/test path; ref: BaseMatrix::at)."""
+        ci, _, _ = layout.cyclic_row_maps(self.Mt, self.grid.p)
+        cj, _, _ = layout.cyclic_row_maps(self.Nt, self.grid.q)
+        return self.data[int(ci[i]), int(cj[j])]
+
+    def set_tile(self, i: int, j: int, tile) -> "TileStorage":
+        ci, _, _ = layout.cyclic_row_maps(self.Mt, self.grid.p)
+        cj, _, _ = layout.cyclic_row_maps(self.Nt, self.grid.q)
+        data = self.data.at[int(ci[i]), int(cj[j])].set(tile)
+        return TileStorage(data, self.m, self.n, self.mb, self.nb, self.grid)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def astype(self, dtype) -> "TileStorage":
+        """Precision-converting copy (ref: copy / gecopy convert path)."""
+        return TileStorage(self.data.astype(dtype), self.m, self.n,
+                           self.mb, self.nb, self.grid)
+
+    def __repr__(self):
+        return (f"TileStorage({self.m}x{self.n}, tiles {self.mb}x{self.nb}, "
+                f"grid {self.grid.p}x{self.grid.q}, {self.dtype})")

@@ -1,0 +1,1 @@
+from . import blas3  # noqa: F401

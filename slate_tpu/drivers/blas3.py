@@ -1,0 +1,106 @@
+"""Parallel BLAS-3 drivers.
+
+Analog of the reference's L6 BLAS-3 routine set (ref: src/gemm.cc, gemmC.cc,
+gemmA.cc, hemm.cc, symm.cc, trmm.cc, trsm.cc/trsmA.cc/trsmB.cc, herk.cc,
+syrk.cc, her2k.cc, syr2k.cc).  Each driver:
+
+- validates shapes and resolves the execution target (Option::Target),
+- single target: statically-shaped dense/blocked computation under jit —
+  the analog of the HostTask path but feeding the whole problem to the MXU,
+- mesh target: shard_map pipeline over the 2D block-cyclic grid with ICI
+  collectives (SUMMA for gemm; masked-panel pipelines for triangular ops).
+
+All drivers are functional: they RETURN the updated matrix instead of
+mutating C (XLA buffer donation recovers in-place performance).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.grid import Grid
+from ..core.matrix import BaseMatrix, Matrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..options import (MethodGemm, Option, Options, Target,
+                       resolve_target, select_gemm_method)
+from ..parallel import summa
+from ..types import Op
+
+
+def as_root_general(A: BaseMatrix, mb: int | None = None,
+                    nb: int | None = None,
+                    grid: Grid | None = None) -> Matrix:
+    """Normalise any view/op/structure to a root general Matrix with the given
+    tile sizes on the given grid (materialises/redistributes only when
+    needed).  Mesh drivers use this so the shard_map body sees plain cyclic
+    storage laid out for the OUTPUT's grid."""
+    mb = mb or A.mb
+    nb = nb or A.nb
+    grid = grid or A.grid
+    if (type(A) is Matrix and A.op is Op.NoTrans and A.is_root_view()
+            and A.mb == mb and A.nb == nb and A.grid is grid):
+        return A
+    dense = A.to_dense()
+    return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
+
+
+def _result_mat(C: BaseMatrix, data) -> Matrix:
+    st = C.storage
+    return Matrix(TileStorage(data, st.m, st.n, st.mb, st.nb, st.grid))
+
+
+# ---------------------------------------------------------------- gemm
+
+def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
+         C: Matrix | None = None, opts: Options | None = None) -> Matrix:
+    """C = alpha op(A) op(B) + beta C (ref: src/gemm.cc:66-89 dispatch,
+    src/gemmC.cc:29-192 stationary-C algorithm)."""
+    slate_error(A.n == B.m, "gemm: inner dims differ")
+    if C is None:
+        dt = jnp.result_type(A.dtype, B.dtype)
+        C = Matrix.zeros(A.m, B.n, A.mb, B.nb, A.grid, dt)
+        beta = 0.0
+    slate_error(C.m == A.m and C.n == B.n, "gemm: C dims differ")
+    target = resolve_target(opts, C)
+    method = select_gemm_method(opts, C.nt)
+
+    if target is Target.mesh and C.grid.mesh is not None:
+        # All operands are normalised onto C's grid (redistributing if they
+        # live elsewhere — the analog of the reference's requirement that all
+        # three matrices share one MPI communicator).
+        del method  # gemmA mesh variant not yet distinct: see gemmA().
+        Cn = as_root_general(C, grid=C.grid)
+        An = as_root_general(A, Cn.storage.mb, None, grid=C.grid)
+        Bn = as_root_general(B, An.storage.nb, Cn.storage.nb, grid=C.grid)
+        slate_error(An.storage.Nt == Bn.storage.Mt, "gemm: k tiling differs")
+        data = summa.summa_gemm_data(
+            An.storage.data, Bn.storage.data, Cn.storage.data,
+            alpha, beta, An.storage.Nt, Cn.grid)
+        return _result_mat(Cn, data)
+
+    # single target: one fused MXU contraction
+    Cd = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
+    return C.with_dense(Cd) if type(C) is Matrix else _dense_to_like(C, Cd)
+
+
+def _dense_to_like(C: BaseMatrix, dense) -> Matrix:
+    g = Matrix.zeros(C.m, C.n, C.mb, C.nb, C.grid, dense.dtype)
+    return g.with_dense(dense)
+
+
+def gemmA(alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
+    """Stationary-A gemm (ref: src/gemmA.cc).  NOTE: on mesh the
+    reduce-over-C-owners communication pattern is not yet distinct — this is
+    currently an alias of the stationary-C path (correct, not comm-optimal
+    for single-block-column C)."""
+    o = dict(opts or {})
+    o[Option.MethodGemm] = MethodGemm.gemmA
+    return gemm(alpha, A, B, beta, C, o)
+
+
+def gemmC(alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
+    """Stationary-C gemm (ref: src/gemmC.cc)."""
+    o = dict(opts or {})
+    o[Option.MethodGemm] = MethodGemm.gemmC
+    return gemm(alpha, A, B, beta, C, o)

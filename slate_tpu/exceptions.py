@@ -1,0 +1,45 @@
+"""Error handling for slate_tpu.
+
+TPU-native analog of the reference's exception layer
+(reference: include/slate/Exception.hh:1-122): `slate::Exception` plus the
+`slate_error` / `slate_assert` macros.  Here errors are Python exceptions; the
+`slate_assert` / `slate_error` helpers keep call sites terse and uniform.
+"""
+
+from __future__ import annotations
+
+
+class SlateError(Exception):
+    """Base error for slate_tpu (ref: Exception.hh `slate::Exception`)."""
+
+
+class SlateValueError(SlateError, ValueError):
+    """Invalid argument (shape/uplo/op mismatches)."""
+
+
+class SlateNotConvergedError(SlateError):
+    """Iterative routine failed to converge (ref: gesv_mixed itermax path)."""
+
+    def __init__(self, msg: str, iters: int = -1):
+        super().__init__(msg)
+        self.iters = iters
+
+
+class SlateNotPositiveDefiniteError(SlateError):
+    """potrf encountered a non-positive-definite matrix."""
+
+    def __init__(self, msg: str, info: int = 0):
+        super().__init__(msg)
+        self.info = info
+
+
+def slate_error(cond: bool, msg: str = "error") -> None:
+    """Raise SlateValueError unless ``cond`` (ref: Exception.hh slate_error)."""
+    if not cond:
+        raise SlateValueError(msg)
+
+
+def slate_assert(cond: bool, msg: str = "assertion failed") -> None:
+    """Internal-consistency assert (ref: Exception.hh slate_assert)."""
+    if not cond:
+        raise AssertionError(msg)

@@ -1,0 +1,41 @@
+"""internal::gemm — one trailing-update step on local tiles.
+
+Analog of the reference's batched tile gemm (ref:
+src/internal/internal_gemm.cc:383-688).  The reference flattens the trailing
+tiles into <=4 `blas::batch::gemm` calls per device (interior / bottom row /
+right col / corner, to handle ragged boundary tiles).  On TPU the pad-to-zero
+invariant makes all tiles uniform mb*nb, so the four regions collapse into a
+single einsum contraction that XLA lowers onto the MXU as one batched matmul
+— the whole point of the blocked-with-padding layout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def tile_outer_product(a_col, b_row):
+    """C[i, j] += A[i] @ B[j] over tile batches.
+
+    a_col: [mtl, mb, kb] — one broadcast block column of A
+    b_row: [ntl, kb, nb] — one broadcast block row of B
+    returns [mtl, ntl, mb, nb]
+
+    This is the SUMMA rank-kb update; one XLA dot_general, MXU-shaped.
+    """
+    return jnp.einsum("iab,jbc->ijac", a_col, b_row,
+                      preferred_element_type=a_col.dtype)
+
+
+def blocked_gemm(a_tiles, b_tiles):
+    """Full blocked product over canonical tile arrays.
+
+    a_tiles: [Mt, Kt, mb, kb], b_tiles: [Kt, Nt, kb, nb]
+    returns  [Mt, Nt, mb, nb]
+
+    Single-device analog of the reference's per-device batch loop
+    (internal_gemm.cc:614-688): one contraction over (k, kb), which XLA
+    tiles onto the MXU without materialising intermediates.
+    """
+    return jnp.einsum("ikab,kjbc->ijac", a_tiles, b_tiles,
+                      preferred_element_type=a_tiles.dtype)

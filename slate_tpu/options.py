@@ -1,0 +1,212 @@
+"""Options / enums / method auto-selection.
+
+TPU-native analog of the reference's per-call configuration system:
+
+- ``Option`` / ``Options`` map passed to every routine
+  (ref: include/slate/internal/enums.hh:69-101, include/slate/types.hh:32-61).
+- ``Target`` execution-target dispatch (ref: enums.hh:33-39,48-54).  On TPU the
+  meaningful split is *single* (one chip: statically-shaped blocked algorithms,
+  fully unrolled under one jit, maximal MXU utilisation) vs *mesh* (a
+  ``jax.sharding.Mesh`` process grid: shard_map + masked fori_loop pipelines
+  with ICI collectives).  ``HostTask/HostNest/HostBatch/Devices`` from the
+  reference all collapse onto these two, chosen by where the data lives.
+- Method auto-selection heuristics (ref: include/slate/method.hh:25-316).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Mapping
+
+
+class Target(enum.Enum):
+    """Execution target (ref: enums.hh:33-39).
+
+    auto    pick from the matrix' grid (mesh if p*q > 1 else single)
+    single  one device, statically-shaped blocked algorithm under one jit
+    mesh    shard_map over a p*q device mesh, collectives over ICI
+    """
+
+    auto = "auto"
+    single = "single"
+    mesh = "mesh"
+
+    # Reference spellings kept as aliases so ported call sites read naturally.
+    HostTask = "single"
+    Devices = "mesh"
+
+
+class Option(enum.Enum):
+    """Option keys (ref: enums.hh:69-101)."""
+
+    Lookahead = "lookahead"
+    BlockSize = "block_size"
+    InnerBlocking = "inner_blocking"
+    MaxPanelThreads = "max_panel_threads"
+    MaxIterations = "max_iterations"
+    Tolerance = "tolerance"
+    Target = "target"
+    UseFallbackSolver = "use_fallback_solver"
+    PivotThreshold = "pivot_threshold"
+    MethodGemm = "method_gemm"
+    MethodHemm = "method_hemm"
+    MethodTrsm = "method_trsm"
+    MethodCholQR = "method_cholqr"
+    MethodGels = "method_gels"
+    MethodLU = "method_lu"
+    MethodEig = "method_eig"
+    HoldLocalWorkspace = "hold_local_workspace"
+    Depth = "depth"
+    PrintVerbose = "print_verbose"
+    PrintEdgeItems = "print_edgeitems"
+    PrintWidth = "print_width"
+    PrintPrecision = "print_precision"
+
+
+class MethodGemm(enum.Enum):
+    """gemm variant selection (ref: method.hh:76-112)."""
+
+    Auto = "auto"
+    gemmA = "gemmA"  # stationary A, reduce over C owners
+    gemmC = "gemmC"  # stationary C (SUMMA); default for nt >= 2
+
+
+class MethodTrsm(enum.Enum):
+    """trsm variant (ref: method.hh:25-74)."""
+
+    Auto = "auto"
+    trsmA = "trsmA"  # stationary A
+    trsmB = "trsmB"  # stationary B; default
+
+
+class MethodHemm(enum.Enum):
+    Auto = "auto"
+    hemmA = "hemmA"
+    hemmC = "hemmC"
+
+
+class MethodCholQR(enum.Enum):
+    """A^H A accumulation method inside cholqr (ref: method.hh:114-160)."""
+
+    Auto = "auto"
+    GemmA = "gemmA"
+    GemmC = "gemmC"
+    HerkC = "herkC"
+
+
+class MethodGels(enum.Enum):
+    """Least-squares path (ref: method.hh:236-275)."""
+
+    Auto = "auto"
+    QR = "qr"
+    CholQR = "cholqr"
+
+
+class MethodLU(enum.Enum):
+    """LU pivoting variant (ref: method.hh:277-316)."""
+
+    Auto = "auto"
+    PartialPiv = "PPLU"
+    CALU = "CALU"  # tournament pivoting (tntpiv)
+    NoPiv = "NoPiv"
+
+
+class MethodEig(enum.Enum):
+    """Tridiagonal eigensolver kernel (ref: heev.cc:79)."""
+
+    Auto = "auto"
+    QR = "qr"      # steqr2: QR iteration, distributed eigenvector rows
+    DC = "dc"      # stedc: divide and conquer (default)
+
+
+class NormScope(enum.Enum):
+    Columns = "columns"
+    Rows = "rows"
+    Matrix = "matrix"
+
+
+class GridOrder(enum.Enum):
+    """Process-grid numbering order (ref: enums.hh:127-131)."""
+
+    Col = "col"
+    Row = "row"
+
+
+Options = Mapping[Option, Any]
+
+_DEFAULTS = {
+    Option.Lookahead: 1,
+    Option.InnerBlocking: 16,
+    Option.MaxPanelThreads: 1,
+    Option.MaxIterations: 30,
+    Option.Tolerance: None,
+    Option.Target: Target.auto,
+    Option.UseFallbackSolver: True,
+    Option.PivotThreshold: 1.0,
+    Option.MethodGemm: MethodGemm.Auto,
+    Option.MethodHemm: MethodHemm.Auto,
+    Option.MethodTrsm: MethodTrsm.Auto,
+    Option.MethodCholQR: MethodCholQR.Auto,
+    Option.MethodGels: MethodGels.Auto,
+    Option.MethodLU: MethodLU.Auto,
+    Option.MethodEig: MethodEig.DC,
+    Option.HoldLocalWorkspace: False,
+    Option.Depth: 2,
+    Option.PrintVerbose: 4,
+    Option.PrintEdgeItems: 16,
+    Option.PrintWidth: 10,
+    Option.PrintPrecision: 4,
+}
+
+
+def get_option(opts: Options | None, key: Option, default: Any = None) -> Any:
+    """Read one option with framework defaults (ref: types.hh:180-206)."""
+    if opts and key in opts:
+        return opts[key]
+    if default is not None:
+        return default
+    return _DEFAULTS.get(key)
+
+
+def resolve_target(opts: Options | None, matrix) -> Target:
+    """Target::auto resolution: mesh iff the matrix lives on a >1-device grid."""
+    t = get_option(opts, Option.Target)
+    if isinstance(t, str):
+        t = Target(t)
+    if t is not Target.auto:
+        return t
+    grid = getattr(matrix, "grid", None)
+    if grid is not None and grid.size > 1:
+        return Target.mesh
+    return Target.single
+
+
+def select_gemm_method(opts: Options | None, nt: int) -> MethodGemm:
+    """ref: method.hh:87-98 — gemmA when C is a single block column, else gemmC."""
+    m = get_option(opts, Option.MethodGemm)
+    if m is not MethodGemm.Auto:
+        return m
+    return MethodGemm.gemmA if nt < 2 else MethodGemm.gemmC
+
+
+def select_trsm_method(opts: Options | None, nt: int) -> MethodTrsm:
+    """ref: method.hh:56-74 — trsmA for very wide RHS stays with A; default B."""
+    m = get_option(opts, Option.MethodTrsm)
+    if m is not MethodTrsm.Auto:
+        return m
+    return MethodTrsm.trsmB
+
+
+def select_gels_method(opts: Options | None, m: int, n: int) -> MethodGels:
+    """ref: method.hh:236-275 — CholQR for tall-skinny well-shaped problems."""
+    meth = get_option(opts, Option.MethodGels)
+    if meth is not MethodGels.Auto:
+        return meth
+    return MethodGels.CholQR if m >= 3 * n else MethodGels.QR
+
+
+def select_lu_method(opts: Options | None) -> MethodLU:
+    m = get_option(opts, Option.MethodLU)
+    if m is not MethodLU.Auto:
+        return m
+    return MethodLU.PartialPiv

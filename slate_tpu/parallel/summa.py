@@ -1,0 +1,58 @@
+"""Distributed gemm: stationary-C SUMMA over the 2D block-cyclic mesh.
+
+Analog of the reference's gemmC driver + internal::gemm<Devices>
+(ref: src/gemmC.cc:29-192, src/internal/internal_gemm.cc:383-688):
+
+reference                             | here
+------------------------------------- | ----------------------------------
+omp task DAG over k, lookahead la     | lax.fori_loop over k; XLA/TPU
+  (gemmC.cc:99-115)                   |   pipelines independent steps and
+                                      |   overlaps DMA/ICI with MXU compute
+A.listBcastMT(A(i,k) -> row owners)   | bcast_from_col(a_col, k % q)
+B.listBcastMT(B(k,j) -> col owners)   | bcast_from_row(b_row, k % p)
+blas::batch::gemm 4-region            | one einsum over local tile batch
+tileTick workspace release            | SSA temporary, freed by XLA
+
+The loop body is identical on every rank (SPMD); the data-dependent owner
+(k % q) is handled by masked-psum broadcast, so the whole multiply is ONE
+compiled XLA program with Kt collective-permute steps riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.collectives import bcast_from_col, bcast_from_row
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..internal.gemm import tile_outer_product
+
+
+def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int):
+    """Per-shard SUMMA body (runs inside shard_map).
+
+    a_loc [mtl, ktl_a, mb, kb], b_loc [ktl_b, ntl, kb, nb],
+    c_loc [mtl, ntl, mb, nb] — this shard's block-cyclic tiles.
+    """
+
+    def body(k, acc):
+        a_col = lax.dynamic_index_in_dim(a_loc, k // q, axis=1, keepdims=False)
+        a_col = bcast_from_col(a_col, k % q)
+        b_row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0, keepdims=False)
+        b_row = bcast_from_row(b_row, k % p)
+        return acc + tile_outer_product(a_col, b_row)
+
+    acc = lax.fori_loop(0, Kt, body, jnp.zeros_like(c_loc))
+    return alpha * acc + beta * c_loc
+
+
+def summa_gemm_data(a_data, b_data, c_data, alpha, beta, Kt, grid: Grid):
+    """shard_map wrapper over the cyclic storage arrays."""
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, b, c: summa_local(a, b, c, alpha, beta, Kt,
+                                    grid.p, grid.q),
+        mesh=grid.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(a_data, b_data, c_data)

@@ -1,0 +1,102 @@
+"""Scalar/structure type vocabulary.
+
+Analog of the reference's blaspp-derived enums (Op/Uplo/Diag/Layout/Side/Norm)
+used throughout include/slate (ref: include/slate/Tile.hh:40-90 transpose
+views, include/slate/types.hh:103-144 mpi_type mapping).  The mpi_type<T>
+table maps here to jax dtype handling: collectives are dtype-generic, so the
+table reduces to helpers for real/complex introspection and precision pairs
+(used by the mixed-precision solvers).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Op(enum.Enum):
+    NoTrans = "n"
+    Trans = "t"
+    ConjTrans = "c"
+
+
+class Uplo(enum.Enum):
+    Lower = "l"
+    Upper = "u"
+    General = "g"
+
+
+class Diag(enum.Enum):
+    NonUnit = "n"
+    Unit = "u"
+
+
+class Side(enum.Enum):
+    Left = "l"
+    Right = "r"
+
+
+class Layout(enum.Enum):
+    ColMajor = "c"
+    RowMajor = "r"
+
+
+class Norm(enum.Enum):
+    One = "1"
+    Inf = "i"
+    Max = "m"
+    Fro = "f"
+
+
+class TileKind(enum.Enum):
+    """Ownership of a tile buffer (ref: Tile.hh TileKind).
+
+    On TPU all tiles of a matrix live in one XLA-owned buffer; the ownership
+    distinction survives as provenance metadata (user-imported vs framework
+    allocated vs transient workspace) used by the debug/print layer.
+    """
+
+    SlateOwned = "owned"
+    UserOwned = "user"
+    Workspace = "workspace"
+
+
+def compose_op(a: Op, b: Op) -> Op:
+    """op composition for stacked transpose views (ref: Tile.hh:40-90)."""
+    if b is Op.NoTrans:
+        return a
+    if a is Op.NoTrans:
+        return b
+    if a is b:
+        return Op.NoTrans
+    # Trans ∘ ConjTrans = Conj — the reference forbids this too.
+    raise ValueError("unsupported op composition (conj-only view)")
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def real_dtype(dtype):
+    return jnp.finfo(jnp.dtype(dtype)).dtype if not is_complex(dtype) \
+        else jnp.zeros((), dtype).real.dtype
+
+
+def lower_precision(dtype):
+    """Factorisation precision for mixed solvers (f64->f32, c128->c64).
+
+    On TPU this is the key lever: the MXU is natively fast in f32/bf16 while
+    f64 is emulated, so gesv_mixed-style solvers (ref:
+    src/gesv_mixed_gmres.cc:24-117) are the TPU-native high-precision path.
+    """
+    d = jnp.dtype(dtype)
+    table = {np.dtype(np.float64): jnp.float32,
+             np.dtype(np.complex128): jnp.complex64,
+             np.dtype(np.float32): jnp.bfloat16}
+    return table.get(d, d)
+
+
+def eps(dtype) -> float:
+    return float(jnp.finfo(real_dtype(dtype)).eps)

@@ -1,0 +1,13 @@
+"""Version info (ref: src/version.cc, include/slate/slate.hh:30)."""
+
+__version__ = "2026.07.00"
+
+
+def version() -> int:
+    """Integer version YYYYMMRR (ref: slate::version)."""
+    return 2026_07_00
+
+
+def id() -> str:
+    """Source identifier (ref: slate::id)."""
+    return f"slate_tpu {__version__}"

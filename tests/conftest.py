@@ -1,0 +1,26 @@
+"""Test configuration: CPU backend with 8 virtual devices, float64 on.
+
+Mirrors the reference's strategy of testing distributed semantics with MPI
+oversubscription on one node (ref: docs/usage.md:32-42, Jenkinsfile-mpi:186):
+here an 8-device virtual CPU mesh stands in for the TPU pod, per SURVEY.md §4.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
