@@ -28,4 +28,12 @@ from .core.matrix import (  # noqa: F401
     HermitianBandMatrix, HermitianMatrix, Matrix, SymmetricMatrix,
     TrapezoidMatrix, TriangularBandMatrix, TriangularMatrix,
 )
-from .drivers.blas3 import gemm, gemmA, gemmC  # noqa: F401
+from .drivers.blas3 import (  # noqa: F401
+    gemm, gemmA, gemmC, hemm, hemmA, her2k, herk, symm, syr2k, syrk, trmm,
+    trsm,
+)
+from .drivers.auxiliary import (  # noqa: F401
+    add, col_norms, copy, norm, redistribute, scale, scale_row_col, set,
+)
+from .drivers.cholesky import posv, potrf, potri, potrs  # noqa: F401
+from .drivers.inverse import trtri, trtrm  # noqa: F401

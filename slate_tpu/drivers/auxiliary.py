@@ -1,0 +1,186 @@
+"""Auxiliary drivers: add, copy, scale, scale_row_col, set, norm, colNorms,
+redistribute.
+
+Analog of the reference's elementwise/aux driver set (ref: src/add.cc,
+src/copy.cc, src/scale.cc, src/scale_row_col.cc, src/set.cc, src/norm.cc,
+src/redistribute.cc:17-154).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.grid import Grid
+from ..core.matrix import (BandMatrix, BaseBandMatrix, BaseMatrix,
+                           BaseTrapezoidMatrix, HermitianBandMatrix,
+                           HermitianMatrix, Matrix, SymmetricMatrix,
+                           TriangularMatrix)
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..ops import elementwise as ew
+from ..ops import norms as nrm
+from ..types import Diag, Norm, Uplo
+from ..options import NormScope
+
+
+def _st(A: BaseMatrix) -> TileStorage:
+    return A.storage
+
+
+def _simple(*mats) -> bool:
+    """True when tile kernels may run directly on storage: every operand is a
+    root, untransposed view AND the operands agree structurally (all general,
+    or all the same trapezoid class with matching uplo/diag — the reference
+    poses the same requirement on add/copy of trapezoid pairs).  Otherwise
+    drivers fall back to the dense path (to_dense/with_dense), which is
+    correct for any view/op/structure mix."""
+    from ..types import Op
+    if not all(m.is_root_view() and m.op is Op.NoTrans for m in mats):
+        return False
+    first = mats[0]
+    if type(first) is Matrix:
+        return all(type(m) is Matrix for m in mats)
+    return all(type(m) is type(first) and m.uplo is first.uplo and
+               m.diag is first.diag for m in mats)
+
+
+def add(alpha, A: BaseMatrix, beta, B: BaseMatrix) -> BaseMatrix:
+    """B = alpha*A + beta*B (ref: src/add.cc -> internal_geadd/tzadd)."""
+    slate_error(A.m == B.m and A.n == B.n, "add: dims differ")
+    if not _simple(A, B):
+        return B.with_dense(alpha * A.to_dense() + beta * B.to_dense())
+    sa, sb = _st(A), _st(B)
+    if isinstance(B, BaseTrapezoidMatrix):
+        lower = B._uplo_logical() is Uplo.Lower
+        out = ew.tzadd(alpha, sa.canonical(), beta, sb.canonical(),
+                       sb.m, sb.n, sb.mb, sb.nb, lower)
+    else:
+        out = ew.geadd(alpha, sa.canonical(), beta, sb.canonical())
+    return _rewrap(B, sb.with_canonical(out))
+
+
+def copy(A: BaseMatrix, B: BaseMatrix) -> BaseMatrix:
+    """B = A with precision conversion (ref: src/copy.cc gecopy/tzcopy)."""
+    slate_error(A.m == B.m and A.n == B.n, "copy: dims differ")
+    if not _simple(A, B):
+        return B.with_dense(A.to_dense().astype(B.dtype))
+    sa, sb = _st(A), _st(B)
+    if isinstance(B, BaseTrapezoidMatrix):
+        lower = B._uplo_logical() is Uplo.Lower
+        out = ew.tzcopy(sa.canonical(), sb.canonical(), sb.m, sb.n,
+                        sb.mb, sb.nb, lower, sb.dtype)
+    else:
+        out = ew.gecopy(sa.canonical(), sb.dtype)
+    return _rewrap(B, sb.with_canonical(out))
+
+
+def scale(numer, denom, A: BaseMatrix) -> BaseMatrix:
+    """A *= numer/denom (ref: src/scale.cc)."""
+    if not _simple(A):
+        return A.with_dense(A.to_dense() * (numer / denom))
+    sa = _st(A)
+    if isinstance(A, BaseTrapezoidMatrix):
+        lower = A._uplo_logical() is Uplo.Lower
+        out = ew.tzscale(numer, denom, sa.canonical(), sa.m, sa.n,
+                         sa.mb, sa.nb, lower)
+    else:
+        out = ew.gescale(numer, denom, sa.canonical())
+    return _rewrap(A, sa.with_canonical(out))
+
+
+def scale_row_col(r, c, A: BaseMatrix) -> BaseMatrix:
+    """A[i,j] *= r[i]*c[j] (ref: src/scale_row_col.cc equilibration)."""
+    if not _simple(A):
+        r = jnp.asarray(r)
+        c = jnp.asarray(c)
+        return A.with_dense(A.to_dense() * r[:, None] * c[None, :])
+    sa = _st(A)
+    out = ew.gescale_row_col(jnp.asarray(r), jnp.asarray(c), sa.canonical(),
+                             sa.m, sa.n, sa.mb, sa.nb)
+    return _rewrap(A, sa.with_canonical(out))
+
+
+def set(offdiag, diag, A: BaseMatrix) -> BaseMatrix:  # noqa: A001
+    """A = offdiag off-diagonal, diag on diagonal (ref: src/set.cc)."""
+    if not _simple(A):
+        m, n = A.m, A.n
+        d = jnp.full((m, n), offdiag, A.dtype)
+        k = min(m, n)
+        d = d.at[jnp.arange(k), jnp.arange(k)].set(diag)
+        return A.with_dense(d)
+    sa = _st(A)
+    if isinstance(A, BaseTrapezoidMatrix):
+        lower = A._uplo_logical() is Uplo.Lower
+        out = ew.tzset(offdiag, diag, sa.canonical(), sa.m, sa.n,
+                       sa.mb, sa.nb, lower)
+    else:
+        out = ew.geset(offdiag, diag, sa.canonical(), sa.m, sa.n,
+                       sa.mb, sa.nb)
+    return _rewrap(A, sa.with_canonical(out))
+
+
+def norm(norm_type: Norm, A: BaseMatrix,
+         scope: NormScope = NormScope.Matrix):
+    """Matrix norm dispatching on structure (ref: src/norm.cc; kernel files
+    internal_genorm/synorm/henorm/trnorm/gbnorm/hbnorm.cc).  The cross-rank
+    MPI_Allreduce is implicit: reductions over the sharded canonical array
+    compile to psum/pmax over the mesh."""
+    # structured matrices and views/transposes: materialise (expands the
+    # stored triangle / band / mirror) and measure as general
+    if not _simple(A) or (scope is NormScope.Columns
+                          and type(A) is not Matrix):
+        d = A.to_dense()
+        absd = jnp.abs(d)
+        if scope is NormScope.Columns:
+            return jnp.max(absd, axis=0)
+        if norm_type is Norm.Max:
+            return jnp.max(absd)
+        if norm_type is Norm.One:
+            return jnp.max(jnp.sum(absd, axis=0))
+        if norm_type is Norm.Inf:
+            return jnp.max(jnp.sum(absd, axis=1))
+        return jnp.linalg.norm(d)
+    sa = _st(A)
+    tiles = sa.canonical()
+    if scope is NormScope.Columns:
+        return nrm.ge_col_norms(tiles, sa.m, sa.n, sa.mb, sa.nb)
+    if isinstance(A, HermitianBandMatrix):
+        return nrm.hb_norm(norm_type, tiles, sa.n, sa.nb, A.kd,
+                           A.uplo is Uplo.Lower)
+    if isinstance(A, BaseBandMatrix):
+        return nrm.gb_norm(norm_type, tiles, sa.m, sa.n, sa.mb, sa.nb,
+                           A.kl, A.ku)
+    if isinstance(A, (SymmetricMatrix, HermitianMatrix)):
+        return nrm.sy_norm(norm_type, tiles, sa.n, sa.nb,
+                           A.uplo is Uplo.Lower,
+                           hermitian=isinstance(A, HermitianMatrix))
+    if isinstance(A, BaseTrapezoidMatrix):
+        return nrm.tr_norm(norm_type, tiles, sa.m, sa.n, sa.mb, sa.nb,
+                           A._uplo_logical() is Uplo.Lower,
+                           unit_diag=A.diag is Diag.Unit)
+    return nrm.ge_norm(norm_type, tiles, sa.m, sa.n, sa.mb, sa.nb)
+
+
+def col_norms(A: BaseMatrix):
+    """Per-column max-abs (ref: colNorms driver)."""
+    return norm(Norm.Max, A, scope=NormScope.Columns)
+
+
+def redistribute(A: BaseMatrix, mb: int | None = None, nb: int | None = None,
+                 grid: Grid | None = None) -> Matrix:
+    """General re-distribution between any two layouts/grids
+    (ref: src/redistribute.cc:17-154 tile-by-tile send/recv).  On TPU the
+    all-to-all is one resharding, emitted by XLA from the layout change."""
+    mb = mb or A.mb
+    nb = nb or A.nb
+    grid = grid or A.grid
+    dense = A.to_dense()
+    return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
+
+
+def _rewrap(like: BaseMatrix, new_storage: TileStorage) -> BaseMatrix:
+    v = like.__class__.__new__(like.__class__)
+    BaseMatrix.__init__(v, new_storage, like.io, like.jo, like._mt, like._nt,
+                        like.op, like.kind)
+    v._apply_extra_aux(like._extra_aux())
+    return v

@@ -25,7 +25,7 @@ from ..exceptions import slate_error
 from ..options import (MethodGemm, Option, Options, Target,
                        resolve_target, select_gemm_method)
 from ..parallel import summa
-from ..types import Op
+from ..types import Diag, Op, Side, Uplo
 
 
 def as_root_general(A: BaseMatrix, mb: int | None = None,
@@ -87,6 +87,176 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
 def _dense_to_like(C: BaseMatrix, dense) -> Matrix:
     g = Matrix.zeros(C.m, C.n, C.mb, C.nb, C.grid, dense.dtype)
     return g.with_dense(dense)
+
+
+def _side(side) -> Side:
+    if isinstance(side, Side):
+        return side
+    return Side.Left if str(side).lower().startswith("l") else Side.Right
+
+
+# ---------------------------------------------------------------- trsm/trmm
+
+def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
+    """Solve op(A) X = alpha B (Left) or X op(A) = alpha B (Right), A
+    triangular (ref: src/trsm.cc method dispatch -> src/trsmB.cc ->
+    work/work_trsm.cc; trsmA variant src/trsmA.cc).
+
+    single: one XLA triangular_solve (blocked internally, MXU-shaped).
+    mesh: parallel.dist_trsm substitution pipeline with panel broadcasts.
+    """
+    from ..core.matrix import BaseTrapezoidMatrix
+    from ..parallel.dist_trsm import dist_trsm_left
+    sd = _side(side)
+    slate_error(isinstance(A, BaseTrapezoidMatrix), "trsm: A not triangular")
+    slate_error(A._m_store() == A._n_store(), "trsm: A not square")
+    if sd is Side.Left:
+        slate_error(A.n == B.m, "trsm: dims")
+    else:
+        slate_error(B.n == A.m, "trsm: dims")
+    target = resolve_target(opts, B)
+    unit = A.diag is Diag.Unit
+
+    if target is Target.mesh and B.grid.mesh is not None:
+        if sd is Side.Right:
+            if A.op is Op.ConjTrans:
+                # X A^H = alpha B  <=>  A X^H = conj(alpha) B^H
+                Xh = trsm(Side.Left, jnp.conj(jnp.asarray(alpha)),
+                          A.conj_transpose(), _conj_transposed_root(B), opts)
+                return _conj_transposed_root(Xh)
+            # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+            Xt = trsm(Side.Left, alpha, A.transpose(),
+                      _transposed_root(B), opts)
+            return _transposed_root(Xt)
+        lower = A.uplo is Uplo.Lower       # storage triangle
+        nb = A.storage.nb
+        An = _root_storage_triangular(A, grid=B.grid)
+        Bn = as_root_general(B, nb, None, grid=B.grid)
+        data = dist_trsm_left(An.storage.data, Bn.storage.data,
+                              jnp.asarray(alpha, Bn.dtype),
+                              Nt=An.storage.Nt, grid=B.grid, lower=lower,
+                              op_a=A.op, unit_diag=unit, n=An.storage.n)
+        st = Bn.storage
+        return Matrix(TileStorage(data, st.m, st.n, st.mb, st.nb, st.grid))
+
+    ad = A._dense_store()                  # storage triangle, op separate
+    bd = alpha * B.to_dense()
+    lower = A.uplo is Uplo.Lower
+    from jax import lax as _lax
+    xd = _lax.linalg.triangular_solve(
+        ad, bd, left_side=(sd is Side.Left), lower=lower,
+        transpose_a=(A.op is not Op.NoTrans),
+        conjugate_a=(A.op is Op.ConjTrans), unit_diagonal=unit)
+    return _dense_to_like(B, xd)
+
+
+def _transposed_root(B) -> Matrix:
+    """Materialised transpose as a root general matrix on B's grid."""
+    d = B.to_dense().T
+    return Matrix(TileStorage.from_dense(d, B.nb, B.mb, B.grid))
+
+
+def _conj_transposed_root(B) -> Matrix:
+    d = jnp.conj(B.to_dense()).T
+    return Matrix(TileStorage.from_dense(d, B.nb, B.mb, B.grid))
+
+
+def _root_storage_triangular(A, grid=None):
+    """Root general matrix holding A's STORAGE triangle (op ignored —
+    callers pass A.op separately)."""
+    grid = grid or A.grid
+    if (A.op in (Op.NoTrans, Op.Trans, Op.ConjTrans) and A.is_root_view()
+            and A.grid is grid and A.storage.mb == A.storage.nb):
+        return Matrix(A.storage)
+    d = A._dense_store()
+    nb = A.storage.nb
+    return Matrix(TileStorage.from_dense(d, nb, nb, grid))
+
+
+def trmm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
+    """B = alpha op(A) B (Left) or alpha B op(A) (Right), A triangular
+    (ref: src/trmm.cc -> work/work_trmm.cc)."""
+    sd = _side(side)
+    ad = A.to_dense()                      # expands triangle incl. unit diag
+    if resolve_target(opts, B) is Target.mesh and B.grid.mesh is not None:
+        # ride the SUMMA path for the multiply
+        Ag = Matrix(TileStorage.from_dense(ad, A.mb, A.nb, B.grid))
+        return gemm(alpha, Ag, B, 0.0, None, opts) if sd is Side.Left \
+            else gemm(alpha, B, Ag, 0.0, None, opts)
+    bd = B.to_dense()
+    out = alpha * (ad @ bd) if sd is Side.Left else alpha * (bd @ ad)
+    return _dense_to_like(B, out)
+
+
+# ---------------------------------------------------------------- rank-k
+
+def herk(alpha, A, beta, C, opts: Options | None = None):
+    """C = alpha A A^H + beta C, C Hermitian (ref: src/herk.cc,
+    internal_herk.cc:843).  mesh rides the SUMMA gemm on (A, A^H)."""
+    from ..core.matrix import BaseTrapezoidMatrix, HermitianMatrix
+    slate_error(isinstance(C, BaseTrapezoidMatrix),
+                "herk: C must be Hermitian/Symmetric")
+    slate_error(A.m == C.m, "herk: dims")
+    out = gemm(alpha, A, A.conj_transpose(), beta,
+               _general_of(C), opts)
+    return HermitianMatrix._from_view(out, C._uplo_logical())
+
+
+def syrk(alpha, A, beta, C, opts: Options | None = None):
+    """C = alpha A A^T + beta C, C symmetric (ref: src/syrk.cc)."""
+    from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
+    slate_error(isinstance(C, BaseTrapezoidMatrix),
+                "syrk: C must be Symmetric")
+    out = gemm(alpha, A, A.transpose(), beta, _general_of(C), opts)
+    return SymmetricMatrix._from_view(out, C._uplo_logical())
+
+
+def her2k(alpha, A, B, beta, C, opts: Options | None = None):
+    """C = alpha A B^H + conj(alpha) B A^H + beta C (ref: src/her2k.cc,
+    internal_her2k.cc:1062)."""
+    from ..core.matrix import BaseTrapezoidMatrix, HermitianMatrix
+    slate_error(isinstance(C, BaseTrapezoidMatrix),
+                "her2k: C must be Hermitian")
+    t1 = gemm(alpha, A, B.conj_transpose(), beta, _general_of(C), opts)
+    t2 = gemm(jnp.conj(jnp.asarray(alpha)), B, A.conj_transpose(), 1.0,
+              t1, opts)
+    return HermitianMatrix._from_view(t2, C._uplo_logical())
+
+
+def syr2k(alpha, A, B, beta, C, opts: Options | None = None):
+    """C = alpha A B^T + alpha B A^T + beta C (ref: src/syr2k.cc)."""
+    from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
+    slate_error(isinstance(C, BaseTrapezoidMatrix),
+                "syr2k: C must be Symmetric")
+    t1 = gemm(alpha, A, B.transpose(), beta, _general_of(C), opts)
+    t2 = gemm(alpha, B, A.transpose(), 1.0, t1, opts)
+    return SymmetricMatrix._from_view(t2, C._uplo_logical())
+
+
+def hemm(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
+    """C = alpha A B + beta C with A Hermitian (ref: src/hemm.cc,
+    hemmA variant src/hemmA.cc).  A.to_dense() expands the stored triangle,
+    then the multiply rides gemm (SUMMA on mesh)."""
+    sd = _side(side)
+    if sd is Side.Left:
+        return gemm(alpha, A, B, beta, C, opts)
+    return gemm(alpha, B, A, beta, C, opts)
+
+
+def symm(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
+    """C = alpha A B + beta C with A symmetric (ref: src/symm.cc)."""
+    return hemm(side, alpha, A, B, beta, C, opts)
+
+
+def hemmA(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
+    """Stationary-A hemm (ref: src/hemmA.cc); alias of hemm pending a
+    distinct reduce-over-C mesh pattern."""
+    return hemm(side, alpha, A, B, beta, C, opts)
+
+
+def _general_of(C) -> Matrix:
+    """General matrix holding C's expanded structure."""
+    return C if type(C) is Matrix else C.general()
 
 
 def gemmA(alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:

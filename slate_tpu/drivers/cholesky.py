@@ -1,0 +1,96 @@
+"""Cholesky solvers: potrf, potrs, posv (+ band pbtrf/pbsv elsewhere).
+
+Analog of the reference's Cholesky driver chain (ref: src/potrf.cc:141-302
+task-DAG driver, src/potrs.cc two trsm sweeps, src/posv.cc).
+
+single target: statically-shaped blocked right-looking factorisation on the
+dense array — panel potrf (XLA Cholesky on the diagonal block), panel trsm,
+trailing herk — unrolled under one jit, full MXU shapes (the analog of the
+HostTask DAG with the whole problem visible to the compiler).
+
+mesh target: slate_tpu.parallel.dist_chol / dist_trsm shard_map pipelines
+over the 2D block-cyclic grid.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import (BaseTrapezoidMatrix, HermitianMatrix, Matrix,
+                           SymmetricMatrix, TriangularMatrix)
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..options import Options, Target, resolve_target
+from ..parallel.dist_chol import dist_potrf
+from ..types import Diag, Op, Uplo
+from .blas3 import as_root_general, trsm
+from ..internal.potrf import potrf_tile
+
+
+def _potrf_dense_blocked(a, nb: int):
+    """Blocked right-looking Cholesky, lower, static shapes (unrolled)."""
+    n = a.shape[0]
+    for k0 in range(0, n, nb):
+        k1 = min(k0 + nb, n)
+        lkk = potrf_tile(a[k0:k1, k0:k1])
+        a = a.at[k0:k1, k0:k1].set(lkk)
+        if k1 < n:
+            panel = lax.linalg.triangular_solve(
+                lkk, a[k1:, k0:k1], left_side=False, lower=True,
+                transpose_a=True, conjugate_a=True)
+            a = a.at[k1:, k0:k1].set(panel)
+            a = a.at[k1:, k1:].add(-(panel @ jnp.conj(panel).T))
+    return a
+
+
+def potrf(A, opts: Options | None = None) -> TriangularMatrix:
+    """Factor A = L L^H (Lower) or A = U^H U (Upper); returns the triangular
+    factor (ref: src/potrf.cc)."""
+    slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
+                "potrf: need HermitianMatrix/SymmetricMatrix")
+    uplo = A._uplo_logical()
+    target = resolve_target(opts, A)
+    nb = A.nb
+
+    if target is Target.mesh and A.grid.mesh is not None:
+        # factor the LOWER representation; Upper comes back as L^H view
+        full = A.to_dense()
+        st_l = TileStorage.from_dense(full, nb, nb, A.grid)
+        out = dist_potrf(st_l.data, st_l.Nt, A.grid, n=st_l.n)
+        st_out = TileStorage(out, st_l.m, st_l.n, nb, nb, A.grid)
+        L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)
+        return L.conj_transpose() if uplo is Uplo.Upper else L
+
+    full = A.to_dense()
+    lfac = _potrf_dense_blocked(full, nb)
+    st_out = TileStorage.from_dense(lfac, nb, nb, A.grid)
+    L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)
+    return L.conj_transpose() if uplo is Uplo.Upper else L
+
+
+def potrs(L: TriangularMatrix, B, opts: Options | None = None) -> Matrix:
+    """Solve with the Cholesky factor: two triangular sweeps
+    (ref: src/potrs.cc)."""
+    slate_error(isinstance(L, BaseTrapezoidMatrix), "potrs: need factor")
+    if L._uplo_logical() is Uplo.Lower:
+        Y = trsm("l", 1.0, L, B, opts)
+        return trsm("l", 1.0, L.conj_transpose(), Y, opts)
+    Y = trsm("l", 1.0, L.conj_transpose(), B, opts)
+    return trsm("l", 1.0, L, Y, opts)
+
+
+def posv(A, B, opts: Options | None = None):
+    """Solve A X = B for Hermitian positive definite A
+    (ref: src/posv.cc).  Returns (L, X)."""
+    L = potrf(A, opts)
+    X = potrs(L, B, opts)
+    return L, X
+
+
+def potri(L: TriangularMatrix, opts: Options | None = None):
+    """Inverse from Cholesky factor: A^{-1} = L^-H L^-1
+    (ref: src/potri.cc = trtri + trtrm).  Returns a HermitianMatrix."""
+    from .inverse import trtri, trtrm
+    Linv = trtri(L, opts)
+    return trtrm(Linv, opts)

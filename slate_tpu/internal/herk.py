@@ -1,0 +1,25 @@
+"""internal::herk / syrk — rank-k trailing update on local tiles.
+
+Analog of the reference's internal_herk.cc:843 / internal_syrk.cc:836:
+diagonal tiles get a true herk, off-diagonal tiles a gemm, all batched.
+On TPU both collapse into one einsum over the tile batch; the diagonal
+tiles' redundant strictly-upper work is masked by consumers (triangular
+reads) rather than skipped — trading ~nb^2/2 FLOPs per diagonal tile for
+one uniform MXU contraction.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def herk_panel_update(prow, pcol, conj: bool = True):
+    """C[i, j] -= P[i] @ op(P[j]) for tile batches.
+
+    prow: [S, mb, kb] panel tiles for the rows being updated
+    pcol: [T, nb, kb] panel tiles for the columns being updated
+    returns the SUBTRACTED term [S, T, mb, nb] (caller applies sign/beta).
+    """
+    pc = jnp.conj(pcol) if conj else pcol
+    return jnp.einsum("iab,jcb->ijac", prow, pc,
+                      preferred_element_type=prow.dtype)

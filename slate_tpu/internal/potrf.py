@@ -1,0 +1,16 @@
+"""internal::potrf — diagonal-tile Cholesky factor.
+
+Analog of the reference's internal_potrf.cc:132 (lapack::potrf on the
+diagonal tile, host or device).  The reference delegates the tile factor to
+vendor LAPACK; we delegate to XLA's native blocked Cholesky, which on TPU
+lowers to MXU-shaped HLO — same division of labour, different vendor.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def potrf_tile(a):
+    """Factor one Hermitian positive-definite tile: returns lower L."""
+    return jnp.linalg.cholesky(a)

@@ -1,0 +1,33 @@
+"""internal::trsm — triangular solve against one diagonal tile, batched over
+a tile column/row.
+
+Analog of the reference's internal_trsm.cc:481 / internal_trsmA.cc (single
+block row/col solve, batched on device via blas::batch::trsm).  Here the
+batch is a vmapped XLA triangular_solve over the [batch, mb, nb] tile array.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..types import Op
+
+
+def apply_op_tile(t, op: Op):
+    if op is Op.Trans:
+        return t.swapaxes(-1, -2)
+    if op is Op.ConjTrans:
+        return jnp.conj(t).swapaxes(-1, -2)
+    return t
+
+
+def trsm_tile_batch(tri, b_batch, *, left: bool, lower: bool,
+                    unit_diag: bool = False, op_tri: Op = Op.NoTrans):
+    """Solve op(T) X = B (left) or X op(T) = B (right) for each tile in
+    b_batch [batch, mb, nb] against one triangular tile T."""
+    t = apply_op_tile(tri, op_tri)
+    low = lower if op_tri is Op.NoTrans else not lower
+    return jax.vmap(lambda b: lax.linalg.triangular_solve(
+        t, b, left_side=left, lower=low, unit_diagonal=unit_diag))(b_batch)

@@ -1,0 +1,137 @@
+"""Batched elementwise tile kernels.
+
+TPU-native analog of the reference's device kernel set (ref: src/cuda/
+device_geadd.cu, device_gecopy.cu, device_gescale.cu,
+device_gescale_row_col.cu, device_geset.cu, device_transpose.cu and the tz*
+triangular variants device_tzadd.cu/tzcopy/tzscale/tzset; dispatched through
+src/internal/internal_geadd.cc:494, internal_gecopy.cc, internal_gescale.cc,
+internal_geset.cc etc.).
+
+The reference launches one CUDA block per tile over pointer arrays; here each
+kernel is ONE vectorised XLA op over the canonical tile array
+``[Mt, Nt, mb, nb]`` — XLA fuses chains of them into single HBM passes, which
+is the TPU replacement for hand-fused kernels.
+
+Triangular (tz*) variants take an ``uplo`` and a per-tile role: tiles strictly
+below/above the block diagonal are full; diagonal-block tiles get an
+elementwise triangle mask — exactly the lower/upper split the reference makes
+per-tile (device_tzset.cu).
+
+All kernels preserve the pad-region-zero invariant (masks supplied by
+:func:`valid_masks`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def valid_masks(m, n, mb, nb):
+    """Boolean masks of valid (non-pad) entries: ([Mt, mb], [Nt, nb])."""
+    Mt, Nt = -(-m // mb), -(-n // nb)
+    ri = np.arange(Mt)[:, None] * mb + np.arange(mb)[None, :]
+    cj = np.arange(Nt)[:, None] * nb + np.arange(nb)[None, :]
+    return jnp.asarray(ri < m), jnp.asarray(cj < n)
+
+
+def entry_mask(m, n, mb, nb):
+    """[Mt, Nt, mb, nb] mask of valid entries."""
+    rm, cm = valid_masks(m, n, mb, nb)
+    return rm[:, None, :, None] & cm[None, :, None, :]
+
+
+def tri_mask(m, n, mb, nb, uplo_lower: bool, strict: bool = False):
+    """[Mt, Nt, mb, nb] triangle mask over GLOBAL indices (tz* kernels)."""
+    Mt, Nt = -(-m // mb), -(-n // nb)
+    gi = (np.arange(Mt)[:, None] * mb + np.arange(mb)[None, :])
+    gj = (np.arange(Nt)[:, None] * nb + np.arange(nb)[None, :])
+    gi = gi[:, None, :, None]
+    gj = gj[None, :, None, :]
+    if uplo_lower:
+        mask = (gi > gj) if strict else (gi >= gj)
+    else:
+        mask = (gi < gj) if strict else (gi <= gj)
+    return jnp.asarray(mask)
+
+
+# ---- general kernels (ge*) ----
+
+def geadd(alpha, a_tiles, beta, b_tiles):
+    """B = alpha*A + beta*B (ref: device_geadd.cu, internal_geadd.cc)."""
+    return alpha * a_tiles + beta * b_tiles
+
+
+def gecopy(a_tiles, dtype=None):
+    """Precision-converting copy (ref: device_gecopy.cu; copy driver
+    src/copy.cc supports inter-precision copies)."""
+    return a_tiles.astype(dtype) if dtype is not None else a_tiles
+
+
+def gescale(numer, denom, a_tiles):
+    """A *= numer/denom (ref: device_gescale.cu safe-scaling signature)."""
+    return a_tiles * (numer / denom)
+
+
+def gescale_row_col(r, c, a_tiles, m, n, mb, nb):
+    """A[i, j] *= r[i] * c[j] (ref: device_gescale_row_col.cu, used by
+    equilibration).  r: [m], c: [n] vectors."""
+    Mt, Nt = -(-m // mb), -(-n // nb)
+    rp = jnp.pad(r, (0, Mt * mb - m)).reshape(Mt, mb)
+    cp = jnp.pad(c, (0, Nt * nb - n)).reshape(Nt, nb)
+    return a_tiles * rp[:, None, :, None] * cp[None, :, None, :]
+
+
+def geset(offdiag, diag, like_tiles, m, n, mb, nb):
+    """A = offdiag everywhere, diag on the diagonal (ref: device_geset.cu;
+    geset(0, 1) builds identity).  Pad region set to zero."""
+    Mt, Nt, _, _ = like_tiles.shape
+    gi = np.arange(Mt)[:, None, None, None] * mb + \
+        np.arange(mb)[None, None, :, None]
+    gj = np.arange(Nt)[None, :, None, None] * nb + \
+        np.arange(nb)[None, None, None, :]
+    eye = jnp.asarray(gi == gj)
+    out = jnp.where(eye, diag, offdiag) * jnp.ones_like(like_tiles)
+    return out * entry_mask(m, n, mb, nb).astype(like_tiles.dtype)
+
+
+def transpose_tiles(a_tiles, conj=False):
+    """Out-of-place blocked transpose: [Mt,Nt,mb,nb] -> [Nt,Mt,nb,mb]
+    (ref: device_transpose.cu in/out-of-place batched transpose)."""
+    t = a_tiles.transpose(1, 0, 3, 2)
+    return jnp.conj(t) if conj else t
+
+
+# ---- triangular/trapezoid kernels (tz*) ----
+
+def tzadd(alpha, a_tiles, beta, b_tiles, m, n, mb, nb, uplo_lower):
+    """Triangle-masked add (ref: device_tzadd.cu)."""
+    mask = tri_mask(m, n, mb, nb, uplo_lower)
+    return jnp.where(mask, alpha * a_tiles + beta * b_tiles, b_tiles)
+
+
+def tzcopy(a_tiles, b_tiles, m, n, mb, nb, uplo_lower, dtype=None):
+    """Triangle-masked converting copy (ref: device_tzcopy.cu)."""
+    src = a_tiles.astype(dtype or b_tiles.dtype)
+    mask = tri_mask(m, n, mb, nb, uplo_lower)
+    return jnp.where(mask, src, b_tiles)
+
+
+def tzscale(numer, denom, a_tiles, m, n, mb, nb, uplo_lower):
+    """Triangle-masked scale (ref: device_tzscale.cu)."""
+    mask = tri_mask(m, n, mb, nb, uplo_lower)
+    return jnp.where(mask, a_tiles * (numer / denom), a_tiles)
+
+
+def tzset(offdiag, diag, like_tiles, m, n, mb, nb, uplo_lower):
+    """Triangle set (ref: device_tzset.cu)."""
+    full = geset(offdiag, offdiag, like_tiles, m, n, mb, nb)
+    Mt, Nt, mb_, nb_ = like_tiles.shape
+    gi = np.arange(Mt)[:, None, None, None] * mb + \
+        np.arange(mb_)[None, None, :, None]
+    gj = np.arange(Nt)[None, :, None, None] * nb + \
+        np.arange(nb_)[None, None, None, :]
+    eye = jnp.asarray(gi == gj)
+    full = jnp.where(eye, diag, full)
+    mask = tri_mask(m, n, mb, nb, uplo_lower) & entry_mask(m, n, mb, nb)
+    return jnp.where(mask, full, jnp.zeros_like(full))

@@ -1,0 +1,133 @@
+"""Distributed right-looking Cholesky over the 2D block-cyclic mesh.
+
+Analog of the reference's potrf<Devices> task graph (ref: src/potrf.cc:141-302
+and the HostTask variant :23-133):
+
+reference step k                       | here (inside ONE shard_map program)
+-------------------------------------- | -----------------------------------
+internal::potrf on diagonal tile :213  | diag tile psum-gathered, cholesky
+                                       |   replicated on all ranks (cheaper
+                                       |   than a second broadcast round)
+tileBcast(k,k -> panel column) :219    | (absorbed into the above)
+internal::trsm on panel column :225    | vmapped triangular_solve on the
+                                       |   owner column's local panel tiles
+listBcastMT(A(i,k) -> row i, col i)    | scatter into a global panel buffer
+  :232-242                             |   + psum over both mesh axes
+internal::herk trailing update :254    | einsum over the rank's trailing
+                                       |   slice (static shrinking sizes)
+lookahead tasks :266-287               | XLA pipelines across unrolled k
+release/tileUpdateAllOrigin :289-302   | SSA buffer lifetimes
+
+The k loop is UNROLLED at trace time: each step has statically-shaped
+shrinking trailing slices (the ScaLAPACK discipline), so no masked-FLOP waste
+grows with Nt; per-rank ragged boundaries are handled by masking at most one
+extra tile row/col.  Block-cyclic distribution keeps every rank busy until
+the final panels — the load-balance property the reference gets from the same
+distribution (MatrixStorage.hh:555-568).
+
+Only Uplo.Lower is implemented here; the driver maps Upper problems onto it
+(ref: potrf.cc handles Upper by conjugate-transposing views the same way).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..internal.herk import herk_panel_update
+from ..internal.potrf import potrf_tile
+from ..internal.trsm import trsm_tile_batch
+from ..types import Op
+
+
+def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int):
+    """Per-shard body; a_loc [mtl, ntl, nb, nb] block-cyclic local tiles."""
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    dt = a_loc.dtype
+
+    for k in range(Nt):
+        rk, ck = k % p, k % q
+        kkr, kkc = k // p, k // q
+        # valid extent of diagonal tile k (last tile may be ragged); the pad
+        # diagonal is identity-augmented so the tile factor stays finite
+        # (XLA's potrf NaN-fills the whole tile on a singular input), then
+        # zeroed again before write-back to keep the pad==0 invariant.
+        vk = nb if k < Nt - 1 else n - (Nt - 1) * nb
+        idx = jnp.arange(nb)
+        pad_eye = jnp.diag((idx >= vk).astype(dt))
+        vmask = ((idx[:, None] < vk) & (idx[None, :] < vk))
+
+        # -- diagonal tile: gather from owner, factor everywhere --
+        dtile = jnp.where((r == rk) & (c == ck),
+                          a_loc[kkr, kkc], jnp.zeros((nb, nb), dt))
+        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+        lkk_aug = potrf_tile(dtile + pad_eye)
+        lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
+
+        # -- panel trsm on the owner column's local tiles --
+        pan = a_loc[:, kkc]                       # [mtl, nb, nb]
+        sol = trsm_tile_batch(lkk_aug, pan, left=False, lower=True,
+                              op_tri=Op.ConjTrans)
+
+        # write back: row k gets L_kk (at its owner), rows i>k the solve
+        gi_all = r + p * jnp.arange(mtl)          # global row of each slot
+        keep = (gi_all[:, None, None] <= k)
+        newcol = jnp.where(keep, pan, sol)
+        newcol = jnp.where((gi_all == k)[:, None, None], lkk, newcol)
+        a_loc = jnp.where((c == ck),
+                          a_loc.at[:, kkc].set(newcol), a_loc)
+
+        if k == Nt - 1:
+            break
+
+        # -- broadcast the panel column to every rank (row i + col i owners,
+        #    ref listBcastMT potrf.cc:232-242): scatter to global buffer and
+        #    psum over the mesh --
+        buf = jnp.zeros((p * mtl, nb, nb), dt)
+        contrib = jnp.where((gi_all > k)[:, None, None], sol,
+                            jnp.zeros_like(sol))
+        buf = buf.at[gi_all].set(contrib)
+        buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)   # [p*mtl, nb, nb]
+
+        # -- trailing update on this rank's static-size slice --
+        S = mtl - max(0, (k + 1) // p)            # max local trailing rows
+        T = ntl - max(0, (k + 1) // q)
+        if S <= 0 or T <= 0:
+            continue
+        sr = jnp.clip((k + 1 - r + p - 1) // p, 0, mtl - S)
+        sc = jnp.clip((k + 1 - c + q - 1) // q, 0, ntl - T)
+
+        gi = r + p * (sr + jnp.arange(S))         # global rows of the slice
+        gj = c + q * (sc + jnp.arange(T))
+        prow = gpan[gi]                           # [S, nb, nb]
+        pcol = gpan[gj]                           # [T, nb, nb]
+        upd = herk_panel_update(prow, pcol)       # [S, T, nb, nb]
+
+        z = jnp.zeros((), sr.dtype)
+        cur = lax.dynamic_slice(a_loc, (sr, sc, z, z), (S, T, nb, nb))
+        mask = ((gi > k)[:, None, None, None] & (gj > k)[None, :, None, None])
+        new = jnp.where(mask, cur - upd, cur)
+        a_loc = lax.dynamic_update_slice(a_loc, new, (sr, sc, z, z))
+
+    return a_loc
+
+
+def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None):
+    """Factor the cyclic storage array of a Hermitian (lower) matrix in
+    place: lower tiles of the result hold L.  ``n`` is the element dimension
+    (for ragged last tiles); defaults to Nt*nb (exact tiling)."""
+    mtl = data.shape[0] // grid.p
+    ntl = data.shape[1] // grid.q
+    nb = data.shape[-1]
+    n = n if n is not None else Nt * nb
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl),
+        mesh=grid.mesh, in_specs=(spec,), out_specs=spec)
+    return fn(data)

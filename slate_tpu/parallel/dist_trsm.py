@@ -1,0 +1,140 @@
+"""Distributed triangular solve over the 2D block-cyclic mesh.
+
+Analog of the reference's trsm driver bodies (ref: src/trsmB.cc ->
+src/work/work_trsm.cc:395 task loops with lookahead, panel bcasts via
+listBcastMT, and internal::trsm single-block-row solves).
+
+Left-side solve op(A) X = alpha B with A triangular, B distributed
+[Mt_b x Nt_b] on the same grid.  The four (uplo, op) combinations reduce to
+forward substitution on an effective-lower factor or backward substitution
+on an effective-upper factor; the panel of effective column k is A's column
+k (op == NoTrans) or A's row k op-applied (op == Trans/ConjTrans), mirroring
+how work::trsm walks the transposed matrix (work_trsm.cc).
+
+Right-side solves are mapped to left solves by the driver via
+X op(A) = B  <=>  op(A)^T X^T = B^T (ref: trsm.cc does the same with views).
+
+Structure per step k (inside ONE unrolled shard_map program):
+  1. gather diag tile A(k,k), build effective triangle, replicate
+  2. ranks owning B(k, :) solve their RHS tiles (vmapped triangular_solve)
+  3. broadcast X(k, :) along the p axis; broadcast the effective panel
+     column of A via scatter + psum (the listBcast analog)
+  4. every rank updates its not-yet-solved local B rows:
+     B(i, :) -= Aeff(i, k) @ X(k, :)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..internal.trsm import apply_op_tile
+from ..types import Op, Uplo
+
+
+def _trsm_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a, unit_diag,
+                mtl_a, ntl_a, mtl_b, ntl_b):
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    nbr = b_loc.shape[-1]
+    dt = b_loc.dtype
+
+    b_loc = alpha * b_loc
+
+    eff_lower = lower if op_a is Op.NoTrans else not lower
+    order = range(Nt) if eff_lower else range(Nt - 1, -1, -1)
+
+    for k in order:
+        rk, ck = k % p, k % q
+        kkr, kkc = k // p, k // q
+
+        # -- effective diagonal tile (pad diagonal identity-augmented so the
+        # ragged last tile stays nonsingular; B's pad rows are zero so the
+        # pad solution is exactly zero) --
+        vk = nb if k < Nt - 1 else n - (Nt - 1) * nb
+        idx = jnp.arange(nb)
+        pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
+        dtile = jnp.where((r == rk) & (c == ck), a_loc[kkr, kkc],
+                          jnp.zeros((nb, nb), a_loc.dtype))
+        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+        deff = apply_op_tile(dtile, op_a) + pad_eye
+
+        # -- solve block row k of B on its owner row, bcast along p --
+        brow = b_loc[kkr]                           # [ntl_b, nb, nbr]
+        xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
+            deff, bb, left_side=True, lower=eff_lower,
+            unit_diagonal=unit_diag))(brow)
+        xk = jnp.where(r == rk, xk, jnp.zeros_like(xk))
+        xk = lax.psum(xk, AXIS_P)                   # replicated down columns
+        b_loc = jnp.where(r == rk, b_loc.at[kkr].set(xk), b_loc)
+
+        # remaining rows to update: i > k (fwd) or i < k (bwd)
+        rem = (Nt - 1 - k) if eff_lower else k
+        if rem == 0:
+            continue
+
+        # -- effective panel column k of A, as a global buffer --
+        # op == NoTrans: tiles A(i, k) live in mesh column ck at local col kkc
+        # op != NoTrans: tiles op(A(k, i)) live in mesh row rk at local row kkr
+        if op_a is Op.NoTrans:
+            pan = a_loc[:, kkc]                     # [mtl_a, nb, nb]
+            gi_all = r + p * jnp.arange(mtl_a)
+            buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
+            buf = buf.at[gi_all].set(pan)
+            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+        else:
+            pan = apply_op_tile(a_loc[kkr], op_a)   # [ntl_a, nb, nb]
+            gj_all = c + q * jnp.arange(ntl_a)
+            buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
+            buf = buf.at[gj_all].set(pan)
+            buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
+        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+
+        # -- update this rank's remaining local rows --
+        S = mtl_b - max(0, (k + 1) // p) if eff_lower \
+            else -(-k // p)                        # max local rows with i<k
+        if S <= 0:
+            continue
+        if eff_lower:
+            sr = jnp.clip((k + 1 - r + p - 1) // p, 0, mtl_b - S)
+        else:
+            sr = jnp.zeros((), r.dtype)
+        gi = r + p * (sr + jnp.arange(S))
+        arow = gpan[gi]                             # [S, nb, nb] Aeff(i, k)
+        z = jnp.zeros((), r.dtype)
+        cur = lax.dynamic_slice(b_loc, (sr.astype(r.dtype), z, z, z),
+                                (S, ntl_b, nb, nbr))
+        upd = jnp.einsum("iab,jbc->ijac", arow, xk,
+                         preferred_element_type=dt)
+        if eff_lower:
+            mask = (gi > k)[:, None, None, None]
+        else:
+            mask = (gi < k)[:, None, None, None]
+        new = jnp.where(mask, cur - upd, cur)
+        b_loc = lax.dynamic_update_slice(b_loc, new,
+                                         (sr.astype(r.dtype), z, z, z))
+
+    return b_loc
+
+
+def dist_trsm_left(a_data, b_data, alpha, *, Nt, grid: Grid, lower: bool,
+                   op_a: Op, unit_diag: bool, n: int | None = None):
+    """Solve op(A) X = alpha B; returns X in B's cyclic storage layout."""
+    mtl_a = a_data.shape[0] // grid.p
+    ntl_a = a_data.shape[1] // grid.q
+    mtl_b = b_data.shape[0] // grid.p
+    ntl_b = b_data.shape[1] // grid.q
+    n = n if n is not None else Nt * a_data.shape[-1]
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, b: _trsm_local(
+            a, b, alpha, Nt=Nt, n=n, p=grid.p, q=grid.q, lower=lower,
+            op_a=op_a,
+            unit_diag=unit_diag, mtl_a=mtl_a, ntl_a=ntl_a, mtl_b=mtl_b,
+            ntl_b=ntl_b),
+        mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(a_data, b_data)

@@ -1,0 +1,179 @@
+"""Elementwise/aux driver tests incl. uneven last tiles and mesh grids
+(analog of ref unit tests for internal_geadd/gecopy/gescale/geset/tz*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.types import Norm
+
+
+SHAPES = [(16, 16, 4), (10, 7, 4), (9, 13, 5)]
+
+
+@pytest.mark.parametrize("m,n,mb", SHAPES)
+def test_add_general(rng, m, n, mb):
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, mb)
+    B = st.Matrix.from_numpy(b, mb)
+    out = st.add(2.0, A, -1.0, B)
+    np.testing.assert_allclose(out.to_numpy(), 2 * a - b, atol=1e-14)
+
+
+def test_add_trapezoid(rng):
+    a = rng.standard_normal((10, 10))
+    b = rng.standard_normal((10, 10))
+    A = st.TriangularMatrix.from_numpy(a, 4, st.Uplo.Lower)
+    B = st.TriangularMatrix.from_numpy(b, 4, st.Uplo.Lower)
+    out = st.add(1.0, A, 1.0, B)
+    np.testing.assert_allclose(out.to_numpy(), np.tril(a) + np.tril(b),
+                               atol=1e-14)
+    # storage outside the triangle is untouched
+    np.testing.assert_allclose(
+        np.triu(np.asarray(out.storage.to_dense()), 1), np.triu(b, 1))
+
+
+def test_copy_precision(rng):
+    a = rng.standard_normal((9, 6))
+    A = st.Matrix.from_numpy(a, 4)
+    B = st.Matrix.zeros(9, 6, 4, dtype=jnp.float32)
+    out = st.copy(A, B)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(out.to_numpy(), a.astype(np.float32),
+                               rtol=1e-6)
+
+
+def test_scale_and_scale_row_col(rng):
+    a = rng.standard_normal((7, 5))
+    A = st.Matrix.from_numpy(a, 3)
+    out = st.scale(3.0, 2.0, A)
+    np.testing.assert_allclose(out.to_numpy(), 1.5 * a, atol=1e-14)
+    r = rng.standard_normal(7)
+    c = rng.standard_normal(5)
+    out2 = st.scale_row_col(r, c, A)
+    np.testing.assert_allclose(out2.to_numpy(), a * np.outer(r, c),
+                               atol=1e-14)
+
+
+def test_set_identity(rng):
+    A = st.Matrix.zeros(10, 7, 4)
+    out = st.set(0.0, 1.0, A)
+    np.testing.assert_allclose(out.to_numpy(), np.eye(10, 7), atol=0)
+    # pad region still zero
+    canon = np.asarray(out.storage.canonical())
+    assert np.all(canon[-1, :, 2:, :] == 0)
+
+
+def test_set_trapezoid():
+    A = st.Matrix.zeros(8, 8, 3).triangular(st.Uplo.Upper)
+    out = st.set(2.0, 5.0, A)
+    ref = np.triu(np.full((8, 8), 2.0), 1) + np.diag(np.full(8, 5.0))
+    np.testing.assert_allclose(out.to_numpy(), ref)
+
+
+@pytest.mark.parametrize("norm_t,npfun", [
+    (Norm.Max, lambda a: np.max(np.abs(a))),
+    (Norm.One, lambda a: np.max(np.abs(a).sum(axis=0))),
+    (Norm.Inf, lambda a: np.max(np.abs(a).sum(axis=1))),
+    (Norm.Fro, lambda a: np.linalg.norm(a)),
+])
+@pytest.mark.parametrize("m,n,mb", SHAPES)
+def test_genorm(rng, norm_t, npfun, m, n, mb):
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, mb)
+    got = float(st.norm(norm_t, A))
+    np.testing.assert_allclose(got, npfun(a), rtol=1e-13)
+
+
+def test_genorm_mesh(rng):
+    g = st.Grid(2, 4, devices=jax.devices()[:8])
+    a = rng.standard_normal((30, 22))
+    A = st.Matrix.from_numpy(a, 4, 4, g)
+    np.testing.assert_allclose(float(st.norm(Norm.One, A)),
+                               np.max(np.abs(a).sum(axis=0)), rtol=1e-13)
+
+
+def test_colnorms(rng):
+    a = rng.standard_normal((11, 9))
+    A = st.Matrix.from_numpy(a, 4)
+    np.testing.assert_allclose(np.asarray(st.col_norms(A)),
+                               np.max(np.abs(a), axis=0), rtol=1e-13)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Inf, Norm.Fro])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_trnorm(rng, norm_t, uplo):
+    a = rng.standard_normal((11, 11))
+    A = st.TriangularMatrix.from_numpy(a, 4, uplo)
+    tri = np.tril(a) if uplo is st.Uplo.Lower else np.triu(a)
+    ref = {Norm.Max: np.max(np.abs(tri)),
+           Norm.One: np.max(np.abs(tri).sum(axis=0)),
+           Norm.Inf: np.max(np.abs(tri).sum(axis=1)),
+           Norm.Fro: np.linalg.norm(tri)}[norm_t]
+    np.testing.assert_allclose(float(st.norm(norm_t, A)), ref, rtol=1e-13)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Inf, Norm.Fro])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_synorm(rng, norm_t, uplo):
+    a = rng.standard_normal((13, 13))
+    A = st.SymmetricMatrix.from_numpy(a, 4, uplo)
+    full = A.to_numpy()
+    ref = {Norm.Max: np.max(np.abs(full)),
+           Norm.One: np.max(np.abs(full).sum(axis=0)),
+           Norm.Inf: np.max(np.abs(full).sum(axis=1)),
+           Norm.Fro: np.linalg.norm(full)}[norm_t]
+    np.testing.assert_allclose(float(st.norm(norm_t, A)), ref, rtol=1e-13)
+
+
+@pytest.mark.parametrize("norm_t", [Norm.Max, Norm.One, Norm.Fro])
+def test_gbnorm(rng, norm_t):
+    a = rng.standard_normal((12, 12))
+    A = st.BandMatrix.from_numpy(a, 2, 3, 4)
+    band = A.to_numpy()
+    ref = {Norm.Max: np.max(np.abs(band)),
+           Norm.One: np.max(np.abs(band).sum(axis=0)),
+           Norm.Fro: np.linalg.norm(band)}[norm_t]
+    np.testing.assert_allclose(float(st.norm(norm_t, A)), ref, rtol=1e-13)
+
+
+def test_norm_of_transpose_view(rng):
+    a = rng.standard_normal((9, 5))
+    A = st.Matrix.from_numpy(a, 4)
+    np.testing.assert_allclose(float(st.norm(Norm.One, A.T)),
+                               np.max(np.abs(a.T).sum(axis=0)), rtol=1e-13)
+
+
+def test_redistribute_roundtrip(rng):
+    a = rng.standard_normal((24, 20))
+    g1 = st.Grid(2, 4, devices=jax.devices()[:8])
+    g2 = st.Grid(4, 2, devices=jax.devices()[:8])
+    A = st.Matrix.from_numpy(a, 4, 4, g1)
+    B = st.redistribute(A, 6, 5, g2)
+    assert B.grid is g2 and B.mb == 6
+    np.testing.assert_allclose(B.to_numpy(), a)
+    C = st.redistribute(B, 4, 4, g1)
+    np.testing.assert_allclose(C.to_numpy(), a)
+
+
+def test_add_structured_source_to_general(rng):
+    """Structure of the SOURCE must be honoured (regression: fast path read
+    raw storage of a triangular view)."""
+    full = rng.standard_normal((8, 8))
+    A = st.Matrix.from_numpy(full, 2).triangular(st.Uplo.Lower)
+    B = st.Matrix.zeros(8, 8, 2, dtype=full.dtype)
+    out = st.add(1.0, A, 1.0, B)
+    np.testing.assert_allclose(out.to_numpy(), np.tril(full))
+    S = st.SymmetricMatrix.from_numpy(full, 2, st.Uplo.Lower)
+    out2 = st.add(1.0, S, 0.0, B)
+    np.testing.assert_allclose(out2.to_numpy(), S.to_numpy())
+
+
+def test_colnorms_structured(rng):
+    full = np.abs(rng.standard_normal((6, 6))) + 1.0
+    A = st.Matrix.from_numpy(full, 2).triangular(st.Uplo.Lower)
+    got = np.asarray(st.col_norms(A))
+    np.testing.assert_allclose(got, np.max(np.abs(np.tril(full)), axis=0))
