@@ -37,3 +37,11 @@ from .drivers.auxiliary import (  # noqa: F401
 )
 from .drivers.cholesky import posv, potrf, potri, potrs  # noqa: F401
 from .drivers.inverse import trtri, trtrm  # noqa: F401
+from .drivers.lu import (  # noqa: F401
+    LUFactors, gesv, gesv_nopiv, getrf, getrf_nopiv, getrf_tntpiv, getri,
+    getriOOP, getrs,
+)
+from .drivers.mixed import (  # noqa: F401
+    MixedResult, gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,
+)
+from .util.generator import generate_hermitian, generate_matrix  # noqa: F401

@@ -1,0 +1,164 @@
+"""LU drivers: getrf (partial pivot / nopiv / tournament), getrs, gesv,
+getri.
+
+Analog of the reference's LU chain (ref: src/getrf.cc:23-240,
+src/getrf_nopiv.cc, src/getrf_tntpiv.cc:455, src/getrs.cc, src/gesv.cc,
+src/getri.cc / src/getriOOP.cc; method dispatch src/gesv.cc + method.hh
+MethodLU).
+
+The factorization result is ``LUFactors``: one matrix whose strictly-lower
+part is unit-L and upper part U (exactly the reference's overwritten-A
+convention) plus a global row-permutation vector ``perm`` with
+``A[perm] = L @ U`` — the composition of the reference's per-panel Pivot
+lists (ref: getrf.cc pivots bcast :112-117).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import Matrix, TriangularMatrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..ops.elementwise import entry_mask
+from ..options import (MethodLU, Option, Options, Target, get_option,
+                       resolve_target, select_lu_method)
+from ..parallel.dist_lu import dist_getrf
+from ..types import Diag, Uplo
+from .blas3 import as_root_general, trsm
+
+
+class LUFactors(NamedTuple):
+    """L\\U packed in one matrix + row permutation (A[perm] = L U)."""
+    LU: Matrix
+    perm: jax.Array
+
+    def lower(self) -> TriangularMatrix:
+        return TriangularMatrix._from_view(self.LU, Uplo.Lower, Diag.Unit)
+
+    def upper(self) -> TriangularMatrix:
+        return TriangularMatrix._from_view(self.LU, Uplo.Upper)
+
+
+def _getrf_dense_blocked(a, nb: int, method: str):
+    """Blocked right-looking LU, statically-shaped panels (unrolled).
+
+    Panel factor delegates to XLA's native pivoted LU (the analog of the
+    reference's lapack panel kernel); trailing update is trsm + one MXU
+    gemm per panel (ref: getrf.cc:174-215 trailing task)."""
+    from ..internal.getrf import panel_lu, panel_lu_nopiv, panel_lu_tournament
+    m, n = a.shape
+    kmax = min(m, n)
+    perm_g = jnp.arange(m)
+    for k0 in range(0, kmax, nb):
+        k1 = min(k0 + nb, kmax)
+        w = k1 - k0
+        pan = a[k0:, k0:k1]
+        if method == "nopiv":
+            lu, perm = panel_lu_nopiv(pan)
+        elif method == "tntpiv":
+            lu, perm = panel_lu_tournament(pan, block_rows=4 * nb)
+        else:
+            lu, perm = panel_lu(pan)
+        a = a.at[k0:, k0:k1].set(lu)
+        if method != "nopiv":
+            a = a.at[k0:, :k0].set(a[k0:, :k0][perm])
+            a = a.at[k0:, k1:].set(a[k0:, k1:][perm])
+            perm_g = perm_g.at[k0:].set(perm_g[k0:][perm])
+        if k1 < n:
+            l11 = lu[:w, :w]
+            u12 = lax.linalg.triangular_solve(
+                l11, a[k0:k1, k1:], left_side=True, lower=True,
+                unit_diagonal=True)
+            a = a.at[k0:k1, k1:].set(u12)
+            if k1 < m:
+                l21 = lu[w:, :w]
+                a = a.at[k1:, k1:].add(-(l21 @ u12))
+    return a, perm_g
+
+
+def getrf(A: Matrix, opts: Options | None = None) -> LUFactors:
+    """LU with partial pivoting (ref: src/getrf.cc)."""
+    return _getrf(A, opts, "partial")
+
+
+def getrf_nopiv(A: Matrix, opts: Options | None = None) -> LUFactors:
+    """LU without pivoting (ref: src/getrf_nopiv.cc)."""
+    return _getrf(A, opts, "nopiv")
+
+
+def getrf_tntpiv(A: Matrix, opts: Options | None = None) -> LUFactors:
+    """CALU tournament-pivoting LU (ref: src/getrf_tntpiv.cc)."""
+    return _getrf(A, opts, "tntpiv")
+
+
+def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
+    target = resolve_target(opts, A)
+    nb = A.nb
+
+    if target is Target.mesh and A.grid.mesh is not None:
+        slate_error(A.m == A.n, "mesh getrf: square matrices (gesv path)")
+        An = as_root_general(A, nb, nb, grid=A.grid)
+        st = An.storage
+        data, perm = dist_getrf(st.data, st.Nt, A.grid, st.n, method,
+                                ib=get_option(opts, Option.InnerBlocking))
+        out = TileStorage(data, st.m, st.n, nb, nb, st.grid)
+        # restore the pad-region-zero invariant (final ragged panel is
+        # identity-augmented inside the factorization)
+        clean = out.canonical() * entry_mask(st.m, st.n, nb, nb).astype(
+            out.dtype)
+        out = out.with_canonical(clean)
+        return LUFactors(Matrix(out), perm[: st.m])
+
+    ad = A.to_dense()
+    lu, perm = _getrf_dense_blocked(ad, nb, method)
+    st = TileStorage.from_dense(lu, nb, nb, A.grid)
+    return LUFactors(Matrix(st), perm)
+
+
+def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
+    """Solve with LU factors: X = U^-1 L^-1 B[perm] (ref: src/getrs.cc)."""
+    slate_error(F.LU.m == B.m, "getrs: dims")
+    bperm = B.to_dense()[F.perm]
+    Bp = Matrix(TileStorage.from_dense(bperm, B.mb, B.nb, B.grid))
+    Y = trsm("l", 1.0, F.lower(), Bp, opts)
+    return trsm("l", 1.0, F.upper(), Y, opts)
+
+
+def gesv(A: Matrix, B, opts: Options | None = None):
+    """Solve A X = B via LU (ref: src/gesv.cc; MethodLU dispatch).
+    Returns (LUFactors, X)."""
+    method = select_lu_method(opts)
+    if method is MethodLU.NoPiv:
+        F = getrf_nopiv(A, opts)
+    elif method is MethodLU.CALU:
+        F = getrf_tntpiv(A, opts)
+    else:
+        F = getrf(A, opts)
+    X = getrs(F, B, opts)
+    return F, X
+
+
+def gesv_nopiv(A: Matrix, B, opts: Options | None = None):
+    """ref: src/gesv_nopiv.cc"""
+    F = getrf_nopiv(A, opts)
+    return F, getrs(F, B, opts)
+
+
+def getri(F: LUFactors, opts: Options | None = None) -> Matrix:
+    """In-place-style inverse from LU factors (ref: src/getri.cc):
+    A^-1 = U^-1 L^-1 P."""
+    n = F.LU.m
+    eye = jnp.eye(n, dtype=F.LU.dtype)
+    I = Matrix(TileStorage.from_dense(eye, F.LU.mb, F.LU.nb, F.LU.grid))
+    return getrs(F, I, opts)
+
+
+def getriOOP(A: Matrix, opts: Options | None = None) -> Matrix:
+    """Out-of-place inverse (ref: src/getriOOP.cc): factor + solve vs I."""
+    F = getrf(A, opts)
+    return getri(F, opts)

@@ -1,0 +1,130 @@
+"""internal::getrf — LU panel factorizations.
+
+Analog of the reference's threaded+MPI LU panels:
+
+- partial pivoting panel (ref: src/internal/internal_getrf.cc:20-119 +
+  Tile_getrf.hh:99-444): `MaxPanelThreads` host threads cooperate over the
+  local tiles of one panel column, with an MPI_Allreduce(MAXLOC) per column
+  across the panel ranks and a bcast of the pivot row.  On TPU the panel is
+  skinny (W x nb) and per-chip compute is enormous, so the panel is gathered
+  and factored REPLICATED on every rank with XLA's native partially-pivoted
+  LU — trading a few redundant kilo-FLOPs for the elimination of nb
+  latency-bound MAXLOC rounds per panel (the reference's known bottleneck).
+- no-pivot panel (ref: internal_getrf_nopiv.cc + Tile_getrf_nopiv.hh).
+- tournament pivoting / CALU (ref: internal_getrf_tntpiv.cc:837 +
+  Tile_getrf_tntpiv.hh): blocks of rows are factored independently, each
+  contributes its nb pivot-candidate rows, and a reduction tree selects the
+  final pivot set before one clean factorization.  Here the tournament tree
+  is computed on the (already gathered) panel — the pivot SELECTION is the
+  CALU algorithm with identical numerics, while the communication shape it
+  was invented for is already optimal under replication.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def panel_lu(panel):
+    """Partially-pivoted LU of a gathered panel [W, nb].
+
+    Returns (lu, perm) with panel[perm] = L @ U (L unit lower incl. rows
+    below the square part; U upper nb x nb).
+    """
+    lu, _, perm = lax.linalg.lu(panel)
+    return lu, perm
+
+
+def panel_lu_nopiv(panel):
+    """No-pivot LU of a panel [W, nb] (ref: Tile_getrf_nopiv.hh).
+
+    Square top block factored unpivoted; rows below solved against U.
+    """
+    nb = panel.shape[1]
+    top = panel[:nb]
+    lu_top = _lu_nopiv_square(top)
+    u = jnp.triu(lu_top)
+    below = lax.linalg.triangular_solve(
+        u, panel[nb:], left_side=False, lower=False)
+    lu = jnp.concatenate([lu_top, below], axis=0)
+    perm = jnp.arange(panel.shape[0])
+    return lu, perm
+
+
+def _lu_nopiv_square(a):
+    """Unpivoted LU of a square block via fori_loop Gaussian elimination."""
+    n = a.shape[0]
+
+    def body(j, a):
+        col = a[:, j]
+        pivot = col[j]
+        idx = jnp.arange(n)
+        l = jnp.where(idx > j, col / pivot, jnp.zeros_like(col))
+        a = a - jnp.outer(l, jnp.where(idx > j, a[j], 0.0))
+        a = a.at[:, j].set(jnp.where(idx > j, l, col))
+        return a
+
+    return lax.fori_loop(0, n, body, a)
+
+
+def panel_lu_tournament(panel, block_rows: int):
+    """CALU tournament pivot selection + clean factorization
+    (ref: internal_getrf_tntpiv.cc, Tile_getrf_tntpiv.hh).
+
+    Round 1: factor each block of ``block_rows`` rows independently and keep
+    its nb pivot rows.  Reduction rounds: pairwise merge candidate sets with
+    another LU until one set remains.  Finally permute the chosen rows to the
+    top and factor the whole panel without further pivoting across blocks.
+    Returns (lu, perm) like :func:`panel_lu`.
+    """
+    W, nb = panel.shape
+    rows = jnp.arange(W)
+
+    def best_rows(block, idx):
+        """nb pivot-candidate rows of a block and their global indices."""
+        _, _, p = lax.linalg.lu(block)
+        return block[p[:nb]], idx[p[:nb]]
+
+    # round 1 over static row blocks
+    cands, cidx = [], []
+    for s in range(0, W, block_rows):
+        e = min(s + block_rows, W)
+        blk = panel[s:e]
+        if e - s < nb:  # tiny tail: keep all its rows as candidates
+            cands.append(blk)
+            cidx.append(rows[s:e])
+        else:
+            b, i = best_rows(blk, rows[s:e])
+            cands.append(b)
+            cidx.append(i)
+    # reduction tree
+    while len(cands) > 1:
+        nxt_c, nxt_i = [], []
+        for t in range(0, len(cands), 2):
+            if t + 1 == len(cands):
+                nxt_c.append(cands[t])
+                nxt_i.append(cidx[t])
+            else:
+                merged = jnp.concatenate([cands[t], cands[t + 1]], axis=0)
+                midx = jnp.concatenate([cidx[t], cidx[t + 1]])
+                b, i = best_rows(merged, midx)
+                nxt_c.append(b)
+                nxt_i.append(i)
+        cands, cidx = nxt_c, nxt_i
+    chosen = cidx[0][:nb]                     # global rows chosen as pivots
+
+    # Bring chosen[j] to row j via nb TRANSPOSITIONS (so the composed perm
+    # displaces <= 2 nb rows — the bound the distributed row exchange relies
+    # on, same as partial pivoting's ipiv products), then factor the
+    # permuted panel with NO further pivoting: that is CALU's defining step
+    # (ref: getrf_tntpiv applies the tournament pivots then an unpivoted
+    # panel factorization).
+    def bring(j, arr):
+        pos = jnp.argmax(arr == chosen[j])
+        vj, vp = arr[j], arr[pos]
+        return arr.at[j].set(vp).at[pos].set(vj)
+
+    perm = lax.fori_loop(0, nb, bring, jnp.arange(W))
+    lu, _ = panel_lu_nopiv(panel[perm])
+    return lu, perm

@@ -1,0 +1,36 @@
+"""Generator determinism and kind properties (analog of ref
+test/matrix_generator.cc checks)."""
+
+import jax
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu.util.generator import generate_hermitian, generate_matrix
+
+
+def test_deterministic_across_distributions():
+    """Same seed -> same GLOBAL matrix regardless of grid/tile sizes
+    (ref: CHANGELOG.md:9-10 determinism guarantee)."""
+    a1 = generate_matrix("randn", 20, 14, 4, seed=7).to_numpy()
+    g = st.Grid(2, 4, devices=jax.devices()[:8])
+    a2 = generate_matrix("randn", 20, 14, 5, 7, seed=7, grid=g).to_numpy()
+    np.testing.assert_allclose(a1, a2)
+
+
+def test_svd_cond():
+    A = generate_matrix("svd", 32, 32, 8, seed=1, cond=1e4)
+    s = np.linalg.svd(A.to_numpy(), compute_uv=False)
+    np.testing.assert_allclose(s[0] / s[-1], 1e4, rtol=1e-8)
+
+
+def test_poev_spd():
+    A = generate_hermitian("poev", 24, 8, seed=2, cond=100.0)
+    w = np.linalg.eigvalsh(A.to_numpy())
+    assert w.min() > 0
+    np.testing.assert_allclose(w.max() / w.min(), 100.0, rtol=1e-8)
+
+
+def test_kinds_run():
+    for kind in ("zeros", "ones", "identity", "jordan", "rand", "rands",
+                 "rand_dominant", "chebspec", "heev"):
+        generate_matrix(kind, 9, 9, 4, seed=0)

@@ -1,0 +1,139 @@
+"""LU tests: getrf/getrs/gesv across methods and targets, incl. an
+adversarial row-scaled matrix that fails without pivoting (analog of ref
+test/test_gesv.cc residual checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def adversarial(rng, n):
+    """Row-scaled so no-pivot LU loses many digits: tiny leading pivot."""
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    a[0, 0] = 1e-14
+    return a
+
+
+@pytest.mark.parametrize("n,nb", [(24, 8), (30, 7)])
+def test_getrf_single(rng, n, nb):
+    a = rng.standard_normal((n, n))
+    A = st.Matrix.from_numpy(a, nb)
+    F = st.getrf(A)
+    l = np.tril(F.LU.to_numpy(), -1) + np.eye(n)
+    u = np.triu(F.LU.to_numpy())
+    perm = np.asarray(F.perm)
+    np.testing.assert_allclose(l @ u, a[perm], rtol=1e-11, atol=1e-11)
+
+
+def test_getrf_rectangular(rng):
+    m, n, nb = 20, 12, 4
+    a = rng.standard_normal((m, n))
+    F = st.getrf(st.Matrix.from_numpy(a, nb))
+    lu = F.LU.to_numpy()
+    l = np.tril(lu, -1)[:, :n] + np.eye(m, n)
+    u = np.triu(lu)[:n]
+    np.testing.assert_allclose(l @ u, a[np.asarray(F.perm)],
+                               rtol=1e-11, atol=1e-11)
+
+
+@pytest.mark.parametrize("method", ["partial", "tntpiv"])
+def test_gesv_adversarial_single(rng, method):
+    n, nb = 24, 8
+    a = adversarial(rng, n)
+    b = rng.standard_normal((n, 3))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    opts = {st.Option.MethodLU:
+            st.MethodLU.CALU if method == "tntpiv" else st.MethodLU.PartialPiv}
+    F, X = st.gesv(A, B, opts)
+    x = X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x))
+    assert resid < 1e-13
+    # no-pivot on the same matrix must be catastrophically worse
+    Fn, Xn = st.gesv_nopiv(A, B)
+    xn = Xn.to_numpy()
+    residn = np.linalg.norm(a @ xn - b) / (np.linalg.norm(a) *
+                                           np.linalg.norm(xn))
+    assert residn > 1e-8
+
+
+@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("n,nb", [(24, 4), (22, 5)])
+def test_gesv_mesh(rng, p, q, n, nb):
+    g = st.Grid(p, q, devices=jax.devices()[: p * q])
+    a = adversarial(rng, n)
+    b = rng.standard_normal((n, 4))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    F, X = st.gesv(A, B)
+    x = X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert resid < 1e-14
+
+
+def test_getrf_mesh_factors(rng):
+    """Mesh factors reproduce A[perm] = L U exactly, pads clean."""
+    n, nb, p, q = 18, 4, 2, 2
+    g = st.Grid(p, q, devices=jax.devices()[: p * q])
+    a = rng.standard_normal((n, n))
+    F = st.getrf(st.Matrix.from_numpy(a, nb, nb, g))
+    lu = F.LU.to_numpy()
+    l = np.tril(lu, -1) + np.eye(n)
+    u = np.triu(lu)
+    np.testing.assert_allclose(l @ u, a[np.asarray(F.perm)],
+                               rtol=1e-11, atol=1e-11)
+    canon = np.asarray(F.LU.storage.canonical())
+    assert np.all(canon[-1, :, 2:, :] == 0)      # pad rows zero
+    assert np.all(canon[:, -1, :, :, ][..., 2:] == 0)
+
+
+def test_gesv_nopiv_mesh(rng):
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = rng.standard_normal((n, n)) + n * np.eye(n)   # diagonally dominant
+    b = rng.standard_normal((n, 2))
+    F, X = st.gesv_nopiv(st.Matrix.from_numpy(a, nb, nb, g),
+                         st.Matrix.from_numpy(b, nb, nb, g))
+    x = X.to_numpy()
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-12
+
+
+def test_gesv_tntpiv_mesh(rng):
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = adversarial(rng, n)
+    b = rng.standard_normal((n, 2))
+    opts = {st.Option.MethodLU: st.MethodLU.CALU}
+    F, X = st.gesv(st.Matrix.from_numpy(a, nb, nb, g),
+                   st.Matrix.from_numpy(b, nb, nb, g), opts)
+    x = X.to_numpy()
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-11
+
+
+def test_getri(rng):
+    n = 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    Ainv = st.getriOOP(st.Matrix.from_numpy(a, 4))
+    np.testing.assert_allclose(Ainv.to_numpy() @ a, np.eye(n),
+                               rtol=1e-11, atol=1e-10)
+
+
+def test_gesv_under_jit(rng):
+    n = 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.Matrix.from_numpy(a, 4)
+    B = st.Matrix.from_numpy(b, 4)
+
+    @jax.jit
+    def solve(A, B):
+        _, X = st.gesv(A, B)
+        return X
+
+    x = solve(A, B).to_numpy()
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-12

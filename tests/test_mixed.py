@@ -1,0 +1,80 @@
+"""Mixed-precision solver tests (analog of ref test/test_gesv.cc --method
+mixed / mixed_gmres paths): f32 factor + f64 refinement must reach full f64
+residuals; the itermax fallback path must engage on hopeless conditioning."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.util.generator import generate_hermitian, generate_matrix
+
+
+def test_gesv_mixed_reaches_double(rng):
+    n, nb = 48, 8
+    A = generate_matrix("svd", n, n, nb, seed=1, cond=1e4)
+    b = rng.standard_normal((n, 3))
+    B = st.Matrix.from_numpy(b, nb)
+    res = st.gesv_mixed(A, B)
+    a = A.to_numpy()
+    x = res.X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert res.converged
+    assert resid < 1e-15          # full double-precision quality
+    assert res.iters <= 30
+
+
+def test_posv_mixed(rng):
+    n, nb = 40, 8
+    A = generate_hermitian("poev", n, nb, seed=3, cond=1e5)
+    b = rng.standard_normal((n, 2))
+    B = st.Matrix.from_numpy(b, nb)
+    res = st.posv_mixed(A, B)
+    a = A.to_numpy()
+    x = res.X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert res.converged and resid < 1e-15
+
+
+def test_gesv_mixed_fallback(rng):
+    """cond ~ 1/eps_single: single-precision factor is useless, the solver
+    must fall back to the full-precision factorization and still succeed
+    (ref: gesv_mixed_gmres.cc:58-77)."""
+    n, nb = 32, 8
+    A = generate_matrix("svd", n, n, nb, seed=5, cond=1e12)
+    b = rng.standard_normal((n, 1))
+    B = st.Matrix.from_numpy(b, nb)
+    res = st.gesv_mixed(A, B)
+    a = A.to_numpy()
+    x = res.X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert res.converged          # via fallback
+    assert resid < 1e-13
+
+
+def test_gesv_mixed_gmres(rng):
+    n, nb = 32, 8
+    A = generate_matrix("svd", n, n, nb, seed=7, cond=1e6)
+    b = rng.standard_normal((n, 2))
+    B = st.Matrix.from_numpy(b, nb)
+    res = st.gesv_mixed_gmres(A, B)
+    a = A.to_numpy()
+    x = res.X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert resid < 1e-14
+
+
+def test_posv_mixed_gmres(rng):
+    n, nb = 32, 8
+    A = generate_hermitian("poev", n, nb, seed=9, cond=1e6)
+    b = rng.standard_normal((n, 1))
+    B = st.Matrix.from_numpy(b, nb)
+    res = st.posv_mixed_gmres(A, B)
+    a = A.to_numpy()
+    x = res.X.to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert resid < 1e-14

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Parameter-sweep tester: the testsweeper/tester analog.
+
+Mirrors the reference's integration tester (ref: test/test.cc:43-80 routine
+sections, test/test_gemm.cc:50-270 params + residual checks, test/run_tests.py
+sweep driver): sweeps {routine, n, nb, grid, dtype, method} combinations,
+checks residuals against numpy/scipy identities, and prints a
+gflops/time/error table with pass/fail per line.
+
+Usage:
+  python tools/tester.py gemm posv gesv --dims 64,128 --nb 16 \
+      --grids 1x1,2x2 --type d
+  python tools/tester.py all --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+if os.environ.get("SLATE_TESTER_BACKEND", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+import slate_tpu as st  # noqa: E402
+from slate_tpu.util.generator import (  # noqa: E402
+    generate_hermitian, generate_matrix)
+
+DTYPES = {"s": np.float32, "d": np.float64,
+          "c": np.complex64, "z": np.complex128}
+_TCODE = {np.float32: "s", np.float64: "d",
+          np.complex64: "c", np.complex128: "z"}
+
+
+def _grid(spec: str) -> st.Grid | None:
+    p, q = (int(x) for x in spec.split("x"))
+    if p * q == 1:
+        return None
+    return st.Grid(p, q, devices=jax.devices()[: p * q])
+
+
+def _gflop(routine, n):
+    return {"gemm": 2 * n ** 3, "posv": n ** 3 / 3 + 2 * n ** 2,
+            "gesv": 2 * n ** 3 / 3 + 2 * n ** 2,
+            "norm": n ** 2, "geqrf": 4 * n ** 3 / 3,
+            "gels": 4 * n ** 3 / 3,
+            "heev": 4 * n ** 3 / 3, "svd": 4 * n ** 3 / 3}.get(routine,
+                                                               n ** 3) / 1e9
+
+
+# ---- per-routine runners: return (error, ok) ----
+
+def run_gemm(n, nb, grid, dtype):
+    A = generate_matrix("randn", n, n, nb, seed=1, dtype=dtype, grid=grid)
+    B = generate_matrix("randn", n, n, nb, seed=2, dtype=dtype, grid=grid)
+    C = st.gemm(1.0, A, B)
+    ref = A.to_numpy() @ B.to_numpy()
+    err = np.linalg.norm(C.to_numpy() - ref) / (np.linalg.norm(ref) + 1)
+    return err, err < 1e-5 if dtype in (np.float32, np.complex64) \
+        else err < 1e-13
+
+
+def run_posv(n, nb, grid, dtype):
+    A = generate_hermitian("poev", n, nb, seed=1, dtype=dtype, cond=100.0,
+                           grid=grid)
+    B = generate_matrix("randn", n, 8, nb, seed=2, dtype=dtype, grid=grid)
+    _, X = st.posv(A, B)
+    a, b, x = A.to_numpy(), B.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                       np.linalg.norm(x) * n)
+    return err, err < (1e-4 if dtype in (np.float32, np.complex64) else 1e-14)
+
+
+def run_gesv(n, nb, grid, dtype):
+    A = generate_matrix("rand_dominant", n, n, nb, seed=1, dtype=dtype,
+                        grid=grid)
+    B = generate_matrix("randn", n, 8, nb, seed=2, dtype=dtype, grid=grid)
+    _, X = st.gesv(A, B)
+    a, b, x = A.to_numpy(), B.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                       np.linalg.norm(x) * n)
+    return err, err < (1e-4 if dtype in (np.float32, np.complex64) else 1e-14)
+
+
+def run_norm(n, nb, grid, dtype):
+    A = generate_matrix("randn", n, n, nb, seed=1, dtype=dtype, grid=grid)
+    err = abs(float(st.norm(st.Norm.One, A)) -
+              np.abs(A.to_numpy()).sum(axis=0).max())
+    return err, err < 1e-8
+
+
+RUNNERS = {"gemm": run_gemm, "posv": run_posv, "gesv": run_gesv,
+           "norm": run_norm}
+
+
+def _late_runners():
+    """Routines registered once the corresponding drivers exist."""
+    extra = {}
+    if hasattr(st, "gels"):
+        def run_gels(n, nb, grid, dtype):
+            m = 2 * n
+            A = generate_matrix("randn", m, n, nb, seed=1, dtype=dtype,
+                                grid=grid)
+            B = generate_matrix("randn", m, 4, nb, seed=2, dtype=dtype,
+                                grid=grid)
+            X = st.gels(A, B)
+            a, b, x = A.to_numpy(), B.to_numpy(), X.to_numpy()[:n]
+            # normal-equations residual: A^H (A x - b) ~ 0
+            err = np.linalg.norm(a.conj().T @ (a @ x - b)) / (
+                np.linalg.norm(a) ** 2 * np.linalg.norm(x) + 1e-300)
+            return err, err < (1e-4 if dtype in (np.float32, np.complex64)
+                               else 1e-12)
+        extra["gels"] = run_gels
+    if hasattr(st, "heev"):
+        def run_heev(n, nb, grid, dtype):
+            A = generate_hermitian("heev", n, nb, seed=1, dtype=dtype,
+                                   cond=100.0, grid=grid)
+            lam, Z = st.heev(A)
+            a = A.to_numpy()
+            lam_np = np.linalg.eigvalsh(a)
+            err = np.max(np.abs(np.sort(np.asarray(lam)) - lam_np)) / (
+                np.abs(lam_np).max() + 1e-300)
+            return err, err < 1e-10
+        extra["heev"] = run_heev
+    if hasattr(st, "svd"):
+        def run_svd(n, nb, grid, dtype):
+            A = generate_matrix("svd", n, n, nb, seed=1, dtype=dtype,
+                                cond=100.0, grid=grid)
+            s = st.svd_vals(A)
+            s_np = np.linalg.svd(A.to_numpy(), compute_uv=False)
+            err = np.max(np.abs(np.sort(np.asarray(s))[::-1] - s_np)) / (
+                s_np.max() + 1e-300)
+            return err, err < 1e-10
+        extra["svd"] = run_svd
+    return extra
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("routines", nargs="+")
+    ap.add_argument("--dims", default="64,128")
+    ap.add_argument("--nb", default="16")
+    ap.add_argument("--grids", default="1x1,2x2")
+    ap.add_argument("--type", default="d", help="s,d,c,z")
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args(argv)
+
+    RUNNERS.update(_late_runners())
+    routines = list(RUNNERS) if args.routines == ["all"] else args.routines
+    dims = [int(x) for x in args.dims.split(",")]
+    nbs = [int(x) for x in args.nb.split(",")]
+    grids = args.grids.split(",")
+    dtypes = [DTYPES[t] for t in args.type.split(",")]
+    if args.quick:
+        dims, nbs, grids = dims[:1], nbs[:1], grids[:2]
+
+    hdr = (f"{'routine':8} {'type':4} {'n':>6} {'nb':>4} {'grid':>5} "
+           f"{'time(s)':>9} {'gflops':>9} {'error':>10}  status")
+    print(hdr)
+    print("-" * len(hdr))
+    failures = 0
+    for routine in routines:
+        fn = RUNNERS[routine]
+        for dtype in dtypes:
+            for n in dims:
+                for nb in nbs:
+                    for gspec in grids:
+                        grid = _grid(gspec)
+                        t0 = time.perf_counter()
+                        try:
+                            err, ok = fn(n, nb, grid, dtype)
+                        except Exception as e:  # noqa: BLE001
+                            print(f"{routine:8} {_TCODE[dtype]:4} "
+                                  f"{n:6} {nb:4} {gspec:>5} "
+                                  f"{'-':>9} {'-':>9} {'-':>10}  "
+                                  f"ERROR {type(e).__name__}: {e}")
+                            failures += 1
+                            continue
+                        dt = time.perf_counter() - t0
+                        gf = _gflop(routine, n) / dt
+                        status = "pass" if ok else "FAILED"
+                        failures += 0 if ok else 1
+                        print(f"{routine:8} {_TCODE[dtype]:4} {n:6} "
+                              f"{nb:4} {gspec:>5} {dt:9.3f} {gf:9.2f} "
+                              f"{err:10.2e}  {status}")
+    print(f"\n{failures} failure(s)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
