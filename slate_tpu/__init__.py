@@ -41,6 +41,10 @@ from .drivers.lu import (  # noqa: F401
     LUFactors, gesv, gesv_nopiv, getrf, getrf_nopiv, getrf_tntpiv, getri,
     getriOOP, getrs,
 )
+from .drivers.qr import (  # noqa: F401
+    CAQRFactors, LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
+    gels_qr, geqrf, qr_multiply, unmlq, unmqr,
+)
 from .drivers.mixed import (  # noqa: F401
     MixedResult, gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,
 )

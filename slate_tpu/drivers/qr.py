@@ -1,0 +1,306 @@
+"""QR / LQ / least-squares drivers: geqrf, gelqf, unmqr, unmlq, cholqr, gels.
+
+Analog of the reference's least-squares chain (ref: src/geqrf.cc:195-206
+local panel + ttqrt reduction tree, src/gelqf.cc, src/unmqr.cc, src/unmlq.cc,
+src/cholqr.cc, src/gels.cc:141 + method dispatch method.hh:236-275).
+
+TPU-first shape:
+
+- single target: blocked Householder QR, panels factored by one fori_loop
+  kernel (internal/qr.py) and trailing updates as larfb MXU gemms, the whole
+  factorization unrolled under one jit (the analog of the HostTask DAG).
+- cholqr / gels_cholqr compose herk + potrf + trsm drivers, so they are
+  distributed on a mesh for free — and CholQR is the auto-selected method
+  for tall-skinny problems (the BASELINE tall-skinny config), matching the
+  reference's MethodGels heuristic.
+- mesh geqrf: communication-avoiding CAQR (parallel/dist_qr.py) — local
+  block-cyclic panel QR per mesh row + replicated tt-reduction of the nb x nb
+  R factors, trailing updates via one psum per panel (ref: the ttqrt tree,
+  src/internal/internal_ttqrt.cc:1-160).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import Matrix, TriangularMatrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..internal.qr import (apply_q_left, apply_q_right, build_t,
+                           householder_panel)
+from ..options import (MethodGels, Options, Target,
+                       resolve_target, select_gels_method)
+from ..types import Op, Side, Uplo, is_complex
+from .blas3 import _dense_to_like, _side, gemm, herk, trsm
+from .cholesky import potrf
+
+
+@jax.tree_util.register_pytree_node_class
+class QRFactors:
+    """Packed QR factors: V (unit lower, below diag) \\ R (upper) in ``QR``
+    plus the block-reflector triangles T [K, nb, nb]
+    (ref: geqrf's TriangularFactors T, include/slate/slate.hh geqrf)."""
+
+    def __init__(self, QR: Matrix, T):
+        self.QR = QR
+        self.T = T
+
+    def tree_flatten(self):
+        return (self.QR, self.T), ()
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QRFactors({self.QR.m}x{self.QR.n}, nb={self.QR.nb})"
+
+
+@jax.tree_util.register_pytree_node_class
+class LQFactors:
+    """LQ factors, stored as the QR factors of A^H (A = L Q, Q = Qr^H)."""
+
+    def __init__(self, F: QRFactors):
+        self.F = F
+
+    def tree_flatten(self):
+        return (self.F,), ()
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+class CAQRFactors:
+    """Mesh CAQR factors: packed local V's + final R in ``QR``, per-mesh-row
+    block-reflector triangles ``Tloc`` [p, Kt, nb, nb], and the replicated
+    tt-reduction tree factors ``Vtree`` [Kt, p*nb, nb] / ``Ttree``
+    [Kt, nb, nb] (ref: geqrf's ttqrt tree triangles,
+    src/internal/internal_ttqrt.cc)."""
+
+    def __init__(self, QR: Matrix, Tloc, Vtree, Ttree):
+        self.QR = QR
+        self.Tloc = Tloc
+        self.Vtree = Vtree
+        self.Ttree = Ttree
+
+    def tree_flatten(self):
+        return (self.QR, self.Tloc, self.Vtree, self.Ttree), ()
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"CAQRFactors({self.QR.m}x{self.QR.n}, nb={self.QR.nb})"
+
+
+def _geqrf_dense_blocked(a, nb: int):
+    """Blocked Householder QR on a dense [m, n]; returns (packed, T[K,nb,nb]).
+
+    Statically unrolled panel loop (same discipline as _potrf_dense_blocked):
+    each panel is a fori_loop Householder kernel + larfb trailing gemms.
+    """
+    m, n = a.shape
+    r = min(m, n)
+    Ts = []
+    for k0 in range(0, r, nb):
+        k1 = min(k0 + nb, r)
+        w = k1 - k0
+        panel = a[k0:, k0:k1]
+        packed, taus = householder_panel(panel)
+        T = build_t(packed, taus)
+        a = a.at[k0:, k0:k1].set(packed)
+        if k1 < n:
+            trail = apply_q_left(packed, T, a[k0:, k1:], conj_trans=True)
+            a = a.at[k0:, k1:].set(trail)
+        if w < nb:
+            T = jnp.zeros((nb, nb), T.dtype).at[:w, :w].set(T)
+        Ts.append(T)
+    T_stack = jnp.stack(Ts) if Ts else jnp.zeros((0, nb, nb), a.dtype)
+    return a, T_stack
+
+
+def geqrf(A: Matrix, opts: Options | None = None) -> QRFactors:
+    """QR factorization A = Q R (ref: src/geqrf.cc).  Returns packed factors;
+    use :func:`unmqr` to apply Q and ``triu(R)`` for solves."""
+    nb = A.nb
+    target = resolve_target(opts, A)
+    if target is Target.mesh and A.grid.mesh is not None:
+        from ..parallel.dist_qr import dist_geqrf_data
+        from .blas3 import as_root_general
+        An = as_root_general(A, nb, nb, A.grid)
+        st = An.storage
+        Kt = -(-min(st.m, st.n) // nb)
+        data, Tloc, Vtree, Ttree = dist_geqrf_data(
+            st.data, Kt, st.Mt, st.m, st.n, A.grid)
+        Qm = Matrix(TileStorage(data, st.m, st.n, nb, nb, A.grid))
+        return CAQRFactors(Qm, Tloc, Vtree, Ttree)
+    ad = A.to_dense()
+    packed, T = _geqrf_dense_blocked(ad, nb)
+    Qm = Matrix(TileStorage.from_dense(packed, A.mb, A.nb, A.grid))
+    return QRFactors(Qm, T)
+
+
+def gelqf(A: Matrix, opts: Options | None = None) -> LQFactors:
+    """LQ factorization A = L Q via QR of A^H (ref: src/gelqf.cc computes the
+    mirrored Householder chain; algebraically identical)."""
+    Ah = Matrix(TileStorage.from_dense(
+        jnp.conj(A.to_dense()).T, A.nb, A.mb, A.grid))
+    return LQFactors(geqrf(Ah, opts))
+
+
+def _parse_trans(op, dtype) -> bool:
+    """Map an op spec to conj_trans, rejecting plain-transpose on complex
+    data (LAPACK unmqr rejects 'T' for complex rather than reinterpreting)."""
+    if op in (Op.NoTrans,) or str(op).lower() == "n":
+        return False
+    plain_t = op is Op.Trans or str(op).lower() == "t"
+    slate_error(not (plain_t and is_complex(dtype)),
+                "unmqr: op='t' undefined for complex; use 'c'")
+    return True
+
+
+def _panel_ranges(m: int, n: int, nb: int):
+    r = min(m, n)
+    return [(k0, min(k0 + nb, r)) for k0 in range(0, r, nb)]
+
+
+def unmqr(side, op, F: QRFactors, C, opts: Options | None = None) -> Matrix:
+    """Multiply C by Q (op='n') or Q^H (op='c'/'t') from the given side
+    (ref: src/unmqr.cc).  Q is the implicit factor from :func:`geqrf`."""
+    sd = _side(side)
+    conj_trans = _parse_trans(op, F.QR.dtype)
+    if isinstance(F, CAQRFactors):
+        return _unmqr_caqr(sd, conj_trans, F, C, opts)
+    packed = F.QR.to_dense()
+    mq, nq = packed.shape
+    nb = F.QR.nb
+    cd = C.to_dense()
+    ranges = _panel_ranges(mq, nq, nb)
+    # Q = B_0 B_1 ... B_{K-1}: Q^H C / C Q apply panels ascending,
+    # Q C / C Q^H descending.
+    ascending = (sd is Side.Left) == conj_trans
+    order = ranges if ascending else ranges[::-1]
+    for k0, k1 in order:
+        w = k1 - k0
+        pk = packed[k0:, k0:k1]
+        Tk = F.T[k0 // nb][:w, :w]
+        if sd is Side.Left:
+            cd = cd.at[k0:, :].set(
+                apply_q_left(pk, Tk, cd[k0:, :], conj_trans))
+        else:
+            cd = cd.at[:, k0:].set(
+                apply_q_right(pk, Tk, cd[:, k0:], conj_trans))
+    return _dense_to_like(C, cd)
+
+
+def _unmqr_caqr(sd: Side, conj_trans: bool, F: CAQRFactors, C,
+                opts: Options | None = None) -> Matrix:
+    """Mesh apply of the CAQR implicit Q (ref: unmqr + ttmqr tree apply)."""
+    from ..parallel.dist_qr import dist_unmqr_data
+    from .blas3 import as_root_general
+    st = F.QR.storage
+    if sd is Side.Right:
+        # C op(Q) = (op(Q)^H C^H)^H — route through the left apply
+        d = jnp.conj(C.to_dense()).T
+        Ct = Matrix(TileStorage.from_dense(d, st.nb, C.mb, C.grid))
+        Xt = _unmqr_caqr(Side.Left, not conj_trans, F, Ct, opts)
+        return _dense_to_like(C, jnp.conj(Xt.to_dense()).T)
+    Cn = as_root_general(C, st.nb, None, grid=F.QR.grid)
+    Kt = F.Tloc.shape[1]
+    data = dist_unmqr_data(st.data, Cn.storage.data, F.Tloc, F.Vtree,
+                           F.Ttree, Kt, st.Mt, st.m, F.QR.grid, conj_trans)
+    cs = Cn.storage
+    return Matrix(TileStorage(data, cs.m, cs.n, cs.mb, cs.nb, cs.grid))
+
+
+def unmlq(side, op, F: LQFactors, C, opts: Options | None = None) -> Matrix:
+    """Multiply C by the LQ factor Q = Qr^H (ref: src/unmlq.cc): flips op on
+    the underlying QR reflectors."""
+    conj_trans = _parse_trans(op, F.F.QR.dtype)
+    return unmqr(side, "n" if conj_trans else "c", F.F, C, opts)
+
+
+def qr_multiply(F: QRFactors):
+    """Materialise the thin Q (first min(m,n) columns) by applying Q to I."""
+    mq = F.QR.m
+    r = min(mq, F.QR.n)
+    eye = jnp.eye(mq, r, dtype=F.QR.dtype)
+    E = Matrix(TileStorage.from_dense(eye, F.QR.mb, F.QR.nb, F.QR.grid))
+    return unmqr(Side.Left, "n", F, E)
+
+
+def _gram(A: Matrix, opts: Options | None):
+    """G = A^H A as a lower Hermitian matrix (shared by the CholQR paths)."""
+    from ..core.matrix import HermitianMatrix
+    return herk(1.0, A.conj_transpose(), 0.0,
+                HermitianMatrix._from_view(
+                    Matrix.zeros(A.n, A.n, A.nb, A.nb, A.grid, A.dtype),
+                    Uplo.Lower), opts)
+
+
+def cholqr(A: Matrix, opts: Options | None = None):
+    """Cholesky QR: G = A^H A, R = chol(G)^H, Q = A R^-1
+    (ref: src/cholqr.cc).  Composes herk/potrf/trsm so the mesh path is the
+    distributed one.  Returns (Q, R) with R upper triangular."""
+    slate_error(A.m >= A.n, "cholqr: need m >= n")
+    G = _gram(A, opts)
+    L = potrf(G, opts)                       # G = L L^H
+    R = L.conj_transpose()                   # upper
+    Q = trsm(Side.Right, 1.0, R, A, opts)    # Q = A R^-1
+    return Q, R
+
+
+def gels_cholqr(A: Matrix, B, opts: Options | None = None) -> Matrix:
+    """Least squares via the semi-normal equations R^H R x = A^H b with R
+    from CholQR (ref: src/gels_cholqr.cc).  Mesh-distributed by
+    construction."""
+    slate_error(A.m >= A.n, "gels_cholqr: need m >= n")
+    L = potrf(_gram(A, opts), opts)
+    Z = gemm(1.0, A.conj_transpose(), B, 0.0, None, opts)   # A^H b
+    Y = trsm(Side.Left, 1.0, L, Z, opts)
+    return trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
+
+
+def gels_qr(A: Matrix, B, opts: Options | None = None) -> Matrix:
+    """Least squares via Householder QR (ref: src/gels_qr.cc):
+    min ||Ax - b||: x = R^-1 (Q^H b)[:n]."""
+    m, n = A.m, A.n
+    slate_error(m >= n, "gels_qr: need m >= n (use gels for m < n)")
+    F = geqrf(A, opts)
+    Y = unmqr(Side.Left, "c", F, B, opts)
+    yd = Y.to_dense()[:n]
+    rd = jnp.triu(F.QR.to_dense()[:n, :n])
+    xd = lax.linalg.triangular_solve(rd, yd, left_side=True, lower=False)
+    X = Matrix.zeros(n, B.n, A.nb, B.nb, A.grid, xd.dtype)
+    return X.with_dense(xd)
+
+
+def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
+    """Linear least squares / minimum-norm solve (ref: src/gels.cc:141):
+
+    m >= n: overdetermined min ||Ax - b||, QR or CholQR per MethodGels
+    (auto: CholQR for tall-skinny, ref method.hh:236-275).
+    m < n:  minimum-norm solution via LQ: x = Q^H L^-1 b.
+    """
+    m, n = A.m, A.n
+    if m >= n:
+        meth = select_gels_method(opts, m, n)
+        if meth is MethodGels.CholQR:
+            return gels_cholqr(A, B, opts)
+        return gels_qr(A, B, opts)
+    # minimum norm: A = L Q (L m x m lower), x = Q^H (L^-1 b)
+    F = gelqf(A, opts)
+    packed = F.F.QR.to_dense()               # QR of A^H: [n, m]
+    ld = jnp.conj(jnp.triu(packed[:m, :m])).T   # L = R^H, lower m x m
+    bd = B.to_dense()
+    yd = lax.linalg.triangular_solve(ld, bd, left_side=True, lower=True)
+    ypad = jnp.zeros((n, yd.shape[1]), yd.dtype).at[:m].set(yd)
+    Yp = Matrix.zeros(n, yd.shape[1], A.nb, B.nb, A.grid, yd.dtype)
+    Yp = Yp.with_dense(ypad)
+    # x = Qlq^H y = Qr y  (Qlq = Qr^H)
+    return unmqr(Side.Left, "n", F.F, Yp, opts)

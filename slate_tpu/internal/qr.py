@@ -1,0 +1,118 @@
+"""internal QR kernels: Householder panel, block reflector T, larfb apply.
+
+Analog of the reference's QR internals (ref: src/internal/internal_geqrf.cc
++ Tile_geqrf.hh threaded panel; internal_unmqr.cc:581 larfb-style trailing
+update; lapackpp larft/larfb used per tile).  TPU-first shape:
+
+- the panel factorization is ONE fori_loop of masked rank-1 updates on the
+  whole [mm, w] panel — static shapes, no per-tile objects, compiles once;
+- the block-reflector triangle T is built from a single MXU gram product
+  V^H V plus a w-step triangular recursion (larft Forward/Columnwise);
+- trailing updates are three MXU gemms (larfb): C -= V T^(H) V^H C.
+
+Conventions (LAPACK-compatible): A = Q R with Q = H_0 H_1 ... H_{r-1},
+H_j = I - tau_j v_j v_j^H, v_j[j] = 1, v_j[:j] = 0.  The factorization
+applies H_j^H (= H_j for real) to the trailing columns.  Q = I - V T V^H.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def householder_panel(a):
+    """Householder QR of a panel ``a`` [mm, w] (mm >= 1, any w).
+
+    Returns (packed, taus): ``packed`` holds R in/above the diagonal and the
+    Householder vectors below it (unit diagonal implied); ``taus`` [w].
+    """
+    mm, w = a.shape
+    r = min(mm, w)
+    rows = jnp.arange(mm)
+    cols = jnp.arange(w)
+    real_dt = jnp.real(a).dtype
+
+    def body(j, carry):
+        a, taus = carry
+        colj = lax.dynamic_index_in_dim(a, j, axis=1, keepdims=False)
+        alpha = lax.dynamic_index_in_dim(colj, j, axis=0, keepdims=False)
+        x = jnp.where(rows > j, colj, jnp.zeros_like(colj))
+        sigma2 = jnp.sum(jnp.real(x * jnp.conj(x)))
+        mu = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + sigma2)
+        # beta = -copysign(mu, Re(alpha)); identity reflector when mu == 0
+        beta = jnp.where(jnp.real(alpha) >= 0, -mu, mu).astype(real_dt)
+        live = mu > 0
+        safe_beta = jnp.where(live, beta, jnp.ones_like(beta))
+        tau = jnp.where(live, (safe_beta - alpha) / safe_beta,
+                        jnp.zeros_like(alpha))
+        scale = jnp.where(live, 1 / jnp.where(live, alpha - safe_beta,
+                                              jnp.ones_like(alpha)),
+                          jnp.zeros_like(alpha))
+        v = jnp.where(rows > j, x * scale, jnp.zeros_like(x))
+        v = jnp.where(rows == j, jnp.ones_like(v), v)
+        # trailing update: a[:, j+1:] -= conj(tau) v (v^H a[:, j+1:])
+        wrow = jnp.conj(v) @ a                       # [w]
+        wrow = jnp.where(cols > j, wrow, jnp.zeros_like(wrow))
+        a = a - jnp.conj(tau) * v[:, None] * wrow[None, :]
+        # write column j: R above+diag(beta), v strictly below
+        newc = jnp.where(rows < j, colj, x * scale)
+        newc = jnp.where(rows == j, beta.astype(a.dtype), newc)
+        newc = jnp.where(live, newc, colj)           # mu==0: leave column
+        a = jnp.where((cols == j)[None, :], newc[:, None], a)
+        taus = taus.at[j].set(tau)
+        return a, taus
+
+    taus0 = jnp.zeros_like(a[0])         # inherits device-variance from a
+    packed, taus = lax.fori_loop(0, r, body, (a, taus0))
+    return packed, taus
+
+
+def unit_lower(packed, r: int | None = None):
+    """Extract V (unit lower trapezoid) from a packed panel [mm, w]."""
+    mm, w = packed.shape
+    r = min(mm, w) if r is None else r
+    rows = jnp.arange(mm)[:, None]
+    cols = jnp.arange(w)[None, :]
+    v = jnp.where(rows > cols, packed, jnp.zeros_like(packed))
+    return jnp.where((rows == cols) & (cols < r),
+                     jnp.ones_like(packed), v)
+
+
+def build_t(packed, taus):
+    """Block-reflector triangle T [w, w] (larft Forward/Columnwise):
+    Q = I - V T V^H, T[j, j] = tau_j, T[:j, j] = -tau_j T V^H v_j."""
+    mm, w = packed.shape
+    V = unit_lower(packed)
+    G = jnp.conj(V).T @ V                            # [w, w] one MXU gram
+    idx = jnp.arange(w)
+
+    def body(j, T):
+        tj = lax.dynamic_index_in_dim(taus, j, axis=0, keepdims=False)
+        gj = lax.dynamic_index_in_dim(G, j, axis=1, keepdims=False)
+        gj = jnp.where(idx < j, gj, jnp.zeros_like(gj))
+        tcol = -tj * (T @ gj)
+        tcol = jnp.where(idx == j, tj, tcol)
+        return jnp.where((idx == j)[None, :], tcol[:, None], T)
+
+    T0 = jnp.zeros_like(G)               # inherits device-variance from V
+    return lax.fori_loop(0, min(mm, w), body, T0)
+
+
+# ---- larfb: apply the block reflector (ref: internal_unmqr.cc larfb path).
+# Q = I - V T V^H;  Q^H = I - V T^H V^H.
+
+def apply_q_left(packed, T, C, conj_trans: bool):
+    """C := Q C (conj_trans=False) or Q^H C (True); rows of C match packed."""
+    V = unit_lower(packed)
+    W = jnp.conj(V).T @ C                            # [w, nc]
+    Tm = jnp.conj(T).T if conj_trans else T
+    return C - V @ (Tm @ W)
+
+
+def apply_q_right(packed, T, C, conj_trans: bool):
+    """C := C Q (conj_trans=False) or C Q^H (True); cols of C match packed."""
+    V = unit_lower(packed)
+    W = C @ V                                        # [nr, w]
+    Tm = jnp.conj(T).T if conj_trans else T
+    return C - (W @ Tm) @ jnp.conj(V).T

@@ -1,0 +1,154 @@
+"""QR / LQ / least-squares tests: geqrf/unmqr/gels/cholqr residuals vs numpy
+on single device and meshes (analog of ref test/test_geqrf.cc,
+test_gels.cc, test_unmqr.cc: orthogonality ||Q^H Q - I|| and factorization
+||A - QR|| / (||A|| n) residuals)."""
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def _thin_q(F, m, r):
+    """Materialise thin Q columns by applying Q to the identity."""
+    eye = np.eye(m, r)
+    E = st.Matrix.from_numpy(eye.astype(F.QR.to_numpy().dtype),
+                             F.QR.nb, F.QR.nb, F.QR.grid)
+    return st.unmqr("l", "n", F, E).to_numpy()
+
+
+@pytest.mark.parametrize("m,n,nb", [(24, 24, 8), (30, 18, 7), (40, 12, 4)])
+def test_geqrf_single(rng, m, n, nb):
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb)
+    F = st.geqrf(A)
+    r = np.triu(F.QR.to_numpy())[:n]
+    q = _thin_q(F, m, n)
+    np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-12)
+    np.testing.assert_allclose(q @ r, a, atol=1e-11)
+
+
+def test_geqrf_complex(rng):
+    m, n, nb = 20, 12, 4
+    a = rng.standard_normal((m, n)) + 1j * rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb)
+    F = st.geqrf(A)
+    r = np.triu(F.QR.to_numpy())[:n]
+    q = _thin_q(F, m, n)
+    np.testing.assert_allclose(q.conj().T @ q, np.eye(n), atol=1e-12)
+    np.testing.assert_allclose(q @ r, a, atol=1e-11)
+
+
+@pytest.mark.parametrize("p,q_,m,n,nb", [
+    (2, 2, 24, 24, 4),       # square, exact tiling
+    (2, 2, 37, 15, 5),       # ragged rows+cols
+    (2, 4, 48, 8, 4),        # tall-skinny on a wide grid
+])
+def test_geqrf_mesh(rng, p, q_, m, n, nb):
+    g = st.Grid(p, q_, devices=jax.devices()[: p * q_])
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    F = st.geqrf(A)
+    r = np.triu(F.QR.to_numpy())[:n]
+    q = _thin_q(F, m, n)
+    np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-11)
+    np.testing.assert_allclose(q @ r, a, atol=1e-10)
+
+
+@pytest.mark.parametrize("target,op,side", [
+    ("single", "n", "l"), ("single", "c", "l"),
+    ("single", "n", "r"), ("single", "c", "r"),
+    ("mesh", "c", "l"), ("mesh", "n", "r"),
+])
+def test_unmqr_orthogonal_apply(rng, target, op, side):
+    m, n, nb = 24, 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
+    a = rng.standard_normal((m, n))
+    F = st.geqrf(st.Matrix.from_numpy(a, nb, nb, g))
+    cshape = (m, 10) if side == "l" else (10, m)
+    cd = rng.standard_normal(cshape)
+    C = st.Matrix.from_numpy(cd, nb, nb, g)
+    X = st.unmqr(side, op, F, C)
+    # Q is orthogonal: applying op then its inverse round-trips
+    Y = st.unmqr(side, "n" if op == "c" else "c", F, X)
+    np.testing.assert_allclose(Y.to_numpy(), cd, atol=1e-11)
+    # and the apply actually changes C (Q != I)
+    assert not np.allclose(X.to_numpy(), cd)
+
+
+@pytest.mark.parametrize("target", ["single", "mesh"])
+def test_gels_qr_tall(rng, target):
+    m, n, nrhs, nb = 36, 12, 3, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, nrhs))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    X = st.gels_qr(A, B)
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy(), xref, atol=1e-10)
+
+
+@pytest.mark.parametrize("target", ["single", "mesh"])
+def test_gels_cholqr_tall(rng, target):
+    m, n, nrhs, nb = 48, 8, 3, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, nrhs))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    X = st.gels_cholqr(A, B)
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy(), xref, atol=1e-9)
+
+
+def test_gels_auto_dispatch(rng):
+    # tall-skinny auto-selects CholQR; mildly rectangular selects QR
+    m, n, nb = 40, 10, 5
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    X = st.gels(st.Matrix.from_numpy(a, nb), st.Matrix.from_numpy(b, nb))
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy(), xref, atol=1e-9)
+
+
+def test_gels_minimum_norm(rng):
+    m, n, nb = 12, 30, 4
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    X = st.gels(st.Matrix.from_numpy(a, nb), st.Matrix.from_numpy(b, nb))
+    x = X.to_numpy()
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]   # minimum-norm solution
+    np.testing.assert_allclose(a @ x, b, atol=1e-10)
+    np.testing.assert_allclose(x, xref, atol=1e-9)
+
+
+def test_cholqr(rng):
+    m, n, nb = 32, 8, 4
+    a = rng.standard_normal((m, n))
+    Q, R = st.cholqr(st.Matrix.from_numpy(a, nb))
+    q, r = Q.to_numpy(), R.to_numpy()
+    np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-11)
+    np.testing.assert_allclose(q @ r, a, atol=1e-11)
+    assert np.allclose(np.tril(r, -1), 0)
+
+
+def test_gelqf_unmlq(rng):
+    m, n, nb = 12, 28, 4
+    a = rng.standard_normal((m, n))
+    F = st.gelqf(st.Matrix.from_numpy(a, nb))
+    packed = F.F.QR.to_numpy()
+    ell = np.triu(packed[:m, :m]).T                # L = R^H
+    # A = L Q  =>  Q = L^-1 A has orthonormal rows
+    q = np.linalg.solve(ell, a)
+    np.testing.assert_allclose(q @ q.T, np.eye(m), atol=1e-11)
+
+
+def test_qr_multiply(rng):
+    m, n, nb = 20, 8, 4
+    a = rng.standard_normal((m, n))
+    F = st.geqrf(st.Matrix.from_numpy(a, nb))
+    Q = st.qr_multiply(F)
+    q = Q.to_numpy()[:, :n]
+    np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-12)
