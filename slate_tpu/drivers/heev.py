@@ -1,0 +1,244 @@
+"""Two-stage Hermitian eigensolver: he2hb -> hb2st -> tridiag eig -> back.
+
+Analog of the reference's heev chain (ref: src/heev.cc:56-177 orchestration;
+src/he2hb.cc:25 stage 1 full->band via panel QR + two-sided her2k-form
+updates; src/hb2st.cc:41-314 stage 2 band->tridiag multithreaded bulge
+chasing; src/stedc.cc:46-96 / src/steqr2.cc tridiagonal kernels;
+src/unmtr_he2hb.cc, src/unmtr_hb2st.cc back-transforms).
+
+TPU-first shape:
+
+- he2hb: blocked Householder band reduction where ALL the O(n^3) work is
+  larfb/her2k-form MXU gemms (the Bischof-Lang two-stage design the
+  reference uses for exactly this reason, SURVEY §5 "hard-dimension
+  scaling"); panels factored by the fori_loop Householder kernel.
+- hb2st: the bulge chase is ONE lax.scan over (sweep, chase-step) pairs with
+  static kd-sized windows — the sequential dependency chain the reference
+  schedules with its sweep/step progress table (hb2st.cc:139-186) becomes a
+  single compiled scan; per-step work is O(kd^2) on dynamic slices.
+- tridiagonal kernel: XLA's eigh on the assembled tridiagonal — the vendor
+  kernel seam, as the reference calls LAPACK steqr2/stedc there.  (stedc
+  divide & conquer is the planned upgrade on this seam.)
+- eigenvectors: Z = Q1 (Q2 Z_tri): Q2 accumulated inside the chase scan,
+  Q1 applied panel-wise with larfb gemms (unmtr_he2hb).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..internal.qr import (apply_q_left, build_t, householder_panel,
+                           householder_vec, unit_lower)
+from ..options import Options
+from ..types import Uplo, is_complex
+
+
+# ---------------------------------------------------------------- stage 1
+
+def _he2hb_dense(a, nb: int):
+    """Full Hermitian (dense, both triangles) -> band of bandwidth nb.
+
+    Returns (a_packed, Ts): band in the nb-diagonals around the main one,
+    Householder panels packed below (ref: he2hb.cc stores V in the zeroed
+    region, same as LAPACK's 2-stage storage), T triangles stacked.
+    """
+    n = a.shape[0]
+    Ts = []
+    for k0 in range(0, max(n - nb, 0), nb):
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        panel = a[k1:, k0:k1]
+        packed, taus = householder_panel(panel)
+        T = build_t(packed, taus)
+        V = unit_lower(packed)                    # [n-k1, w]
+        # two-sided her2k-form update of the trailing block
+        # (ref: he2hb.cc:438-578 he2hb_hemm/her2k_offdiag kernels):
+        # A <- A - V W^H - W V^H,  W = Y T - 1/2 V (T^H (V^H Y) T),  Y = A V
+        trail = a[k1:, k1:]
+        Y = trail @ V
+        VY = jnp.conj(V).T @ Y
+        W = Y @ T - 0.5 * (V @ (jnp.conj(T).T @ (VY @ T)))
+        trail = trail - V @ jnp.conj(W).T - W @ jnp.conj(V).T
+        a = a.at[k1:, k1:].set(trail)
+        # panel region becomes [R; 0] under Q^H; keep V packed below R
+        a = a.at[k1:, k0:k1].set(packed)
+        rtop = jnp.triu(packed[:w])              # [rr, w], rr = min(w, n-k1)
+        mirror = jnp.zeros((w, n - k1), a.dtype)
+        mirror = mirror.at[:, : rtop.shape[0]].set(jnp.conj(rtop).T)
+        a = a.at[k0:k1, k1:].set(mirror)
+        if w < nb:
+            T = jnp.zeros((nb, nb), T.dtype).at[:w, :w].set(T)
+        Ts.append(T)
+    T_stack = (jnp.stack(Ts) if Ts
+               else jnp.zeros((0, nb, nb), a.dtype))
+    return a, T_stack
+
+
+def _band_of(a_packed, kd: int):
+    """Extract the Hermitian band (both triangles) from he2hb packing."""
+    n = a_packed.shape[0]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(n)[None, :]
+    low = jnp.where((i - j <= kd) & (i - j >= 0), a_packed,
+                    jnp.zeros_like(a_packed))
+    low = jnp.tril(low)
+    diag = jnp.diagonal(low)
+    if is_complex(a_packed.dtype):
+        diag = jnp.real(diag).astype(a_packed.dtype)
+    full = low + jnp.conj(low).T
+    return full.at[jnp.arange(n), jnp.arange(n)].set(diag)
+
+
+def _unmtr_he2hb(a_packed, Ts, nb: int, Z):
+    """Z <- Q1 Z where Q1 is the he2hb panel product (ref: unmtr_he2hb.cc)."""
+    n = a_packed.shape[0]
+    K = Ts.shape[0]
+    for idx in range(K - 1, -1, -1):
+        k0 = idx * nb
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        pk = a_packed[k1:, k0:k1]
+        Tk = Ts[idx][:w, :w]
+        Z = Z.at[k1:, :].set(apply_q_left(pk, Tk, Z[k1:, :],
+                                          conj_trans=False))
+    return Z
+
+
+# ---------------------------------------------------------------- stage 2
+
+def _hb2st(band, kd: int, want_q: bool):
+    """Band (full Hermitian, bandwidth kd) -> real tridiagonal (d, e) by
+    Householder bulge chasing; one lax.scan over (sweep, step) pairs
+    (ref: hb2st.cc:41-314 hebr1/2/3 kernel pipeline).
+
+    Returns (d [n], e [n-1], Q2 [n, n] or None) with band = Q2 T Q2^H.
+    """
+    n = band.shape[0]
+    dt = band.dtype
+    if n == 1:
+        d = jnp.real(band[jnp.arange(1), jnp.arange(1)])
+        return d, jnp.zeros((0,), d.dtype), (
+            jnp.eye(1, dtype=dt) if want_q else None)
+    kd = max(1, min(kd, n - 1))
+    N = n + 3 * kd + 2                           # padded to keep slices in
+    A = jnp.zeros((N, N), dt).at[:n, :n].set(band)
+    Q = jnp.eye(N, dtype=dt) if want_q else jnp.zeros((1, 1), dt)
+
+    Tmax = max(1, -(-(n - 1) // kd))             # chase steps per sweep
+
+    def step(carry, jt):
+        A, Q = carry
+        j, t = jt
+        b = j + 1 + t * kd                       # window row base
+        c = jnp.where(t == 0, j, b - kd)         # column being cleared
+        x = lax.dynamic_slice(A, (b, c), (kd, 1))[:, 0]
+        v, tau, _ = householder_vec(x)
+        W = 3 * kd + 1
+        # left: rows [b, b+kd) x cols [c, c+W):  H^H A
+        Wr = lax.dynamic_slice(A, (b, c), (kd, W))
+        Wr = Wr - jnp.conj(tau) * v[:, None] * (jnp.conj(v) @ Wr)[None, :]
+        A = lax.dynamic_update_slice(A, Wr, (b, c))
+        # right: rows [c, c+W) x cols [b, b+kd):  A H
+        Wc = lax.dynamic_slice(A, (c, b), (W, kd))
+        Wc = Wc - tau * (Wc @ v)[:, None] * jnp.conj(v)[None, :]
+        A = lax.dynamic_update_slice(A, Wc, (c, b))
+        if want_q:
+            Qc = lax.dynamic_slice(Q, (0, b), (N, kd))
+            Qc = Qc - tau * (Qc @ v)[:, None] * jnp.conj(v)[None, :]
+            Q = lax.dynamic_update_slice(Q, Qc, (0, b))
+        return (A, Q), None
+
+    js = jnp.repeat(jnp.arange(n - 1), Tmax)
+    ts = jnp.tile(jnp.arange(Tmax), n - 1)
+    (A, Q), _ = lax.scan(step, (A, Q), (js, ts))
+
+    d = jnp.real(jnp.diagonal(A)[:n])
+    e_c = jnp.diagonal(A, offset=-1)[: n - 1]
+    if is_complex(dt):
+        # phase-normalise the subdiagonal (LAPACK zhbtrd final scaling):
+        # T_real = D^H T D, Z gets D folded in
+        mag = jnp.abs(e_c)
+        ph = jnp.where(mag > 0, e_c / jnp.where(mag > 0, mag,
+                                                jnp.ones_like(mag)),
+                       jnp.ones_like(e_c))
+        D = jnp.concatenate([jnp.ones((1,), dt), jnp.cumprod(ph)])
+        e = mag
+        if want_q:
+            Q = Q.at[:, :n].multiply(D[None, :])
+    else:
+        e = e_c
+        D = None
+    return d, e, (Q[:n, :n] if want_q else None)
+
+
+# ---------------------------------------------------------------- driver
+
+def _tridiag_eig(d, e, want_z: bool):
+    """Vendor-kernel seam (ref: heev.cc:141-153 steqr2/stedc dispatch): the
+    tridiagonal problem solved by XLA's native eigh (QDWH on TPU)."""
+    n = d.shape[0]
+    T = (jnp.diag(d) + jnp.diag(e, -1) + jnp.diag(e, 1)
+         if n > 1 else jnp.diag(d))
+    if want_z:
+        return jnp.linalg.eigh(T)
+    return jnp.linalg.eigvalsh(T), None
+
+
+def heev(A, opts: Options | None = None, *, jobz: bool = True):
+    """Eigendecomposition A = Z diag(w) Z^H for Hermitian/symmetric A
+    (ref: src/heev.cc).  Returns (w, Z) — Z is None when jobz=False.
+
+    Mesh matrices are gathered for the reduction (the reference likewise
+    gathers the band to one rank for stage 2, heev.cc:109-111); stage-1
+    distribution is a planned upgrade on this seam.
+    """
+    slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
+                "heev: need HermitianMatrix/SymmetricMatrix")
+    n = A.m
+    nb = A.nb
+    ad = A.to_dense()
+    packed, Ts = _he2hb_dense(ad, nb)
+    band = _band_of(packed, nb)
+    d, e, Q2 = _hb2st(band, nb, want_q=jobz)
+    w, ztri = _tridiag_eig(d, e, jobz)
+    if not jobz:
+        return w, None
+    Z = Q2 @ ztri.astype(Q2.dtype)
+    Z = _unmtr_he2hb(packed, Ts, nb, Z)
+    Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
+    return w, Zm
+
+
+def heevd(A, opts: Options | None = None):
+    """Eigenvalues only (ref: heev with Job::NoVec)."""
+    return heev(A, opts, jobz=False)[0]
+
+
+def hegst(A, L, opts: Options | None = None):
+    """Reduce the generalized problem to standard form:
+    C = L^-1 A L^-H (itype 1, ref: src/hegst.cc) via two triangular
+    solves."""
+    from .blas3 import trsm
+    G = trsm("l", 1.0, L, A.general() if not isinstance(A, Matrix) else A,
+             opts)
+    G2 = trsm("r", 1.0, L.conj_transpose(), G, opts)
+    return HermitianMatrix._from_view(G2, Uplo.Lower)
+
+
+def hegv(A, B, opts: Options | None = None, *, jobz: bool = True):
+    """Generalized Hermitian-definite eigenproblem A x = w B x
+    (ref: src/hegv.cc): B = L L^H, C = L^-1 A L^-H, heev(C), x = L^-H z."""
+    from .blas3 import trsm
+    from .cholesky import potrf
+    L = potrf(B, opts)
+    C = hegst(A, L, opts)
+    w, Z = heev(C, opts, jobz=jobz)
+    if not jobz:
+        return w, None
+    X = trsm("l", 1.0, L.conj_transpose(), Z, opts)
+    return w, X

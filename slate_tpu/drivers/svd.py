@@ -1,0 +1,267 @@
+"""SVD via two-stage bidiagonalization: ge2tb -> tb2bd -> bdsqr -> back.
+
+Analog of the reference's SVD chain (ref: src/svd.cc:65-363 orchestration:
+ge2tb -> ge2tbGather -> tb2bd -> copytb2bd -> lapack::bdsqr on rank 0 ->
+unmbr_tb2bd/unmbr_ge2tb back-transforms; src/ge2tb.cc QR+LQ panel
+alternation; src/tb2bd.cc bulge chasing).
+
+TPU-first shape mirrors drivers/heev.py:
+
+- ge2tb: alternating QR (left) and LQ (right) Householder panels — all
+  O(mn^2) work in larfb MXU gemms; band result is upper triangular with
+  bandwidth nb.
+- tb2bd: bulge chase as ONE lax.scan of alternating right/left kd-window
+  reflectors (the reference's sweep/step task pipeline, tb2bd.cc), with
+  U2/V2 accumulated in the same scan.
+- bidiagonal kernel: XLA's SVD on the assembled bidiagonal — the vendor
+  seam where the reference calls lapack::bdsqr (svd.cc:286).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import Matrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..internal.qr import (apply_q_left, apply_q_right, build_t,
+                           householder_panel, householder_vec, unit_lower)
+from ..options import Options
+from ..types import is_complex
+
+
+# ---------------------------------------------------------------- stage 1
+
+def _ge2tb_dense(a, nb: int):
+    """Dense m x n (m >= n) -> upper triangular band of bandwidth nb.
+
+    Returns (a_packed, Tq, Tl): QR panel reflectors packed below the
+    diagonal, LQ panel reflectors packed right of the band (conjugated,
+    row-space), T triangles for both chains (ref: ge2tb.cc stores U and V
+    households the same way)."""
+    m, n = a.shape
+    Tqs, Tls = [], []
+    for k0 in range(0, n, nb):
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        # left QR panel on cols [k0, k1)
+        packed, taus = householder_panel(a[k0:, k0:k1])
+        Tq = build_t(packed, taus)
+        a = a.at[k0:, k0:k1].set(packed)
+        if k1 < n:
+            trail = apply_q_left(packed, Tq, a[k0:, k1:], conj_trans=True)
+            a = a.at[k0:, k1:].set(trail)
+            # right LQ panel on rows [k0, k1), cols [k1, n):
+            # factor conj(blk)^T = Q_l R_l; blk <- blk conj(Q_l) = [L 0]
+            blk = a[k0:k1, k1:]
+            packed_l, taus_l = householder_panel(jnp.conj(blk).T)
+            Tl = build_t(packed_l, taus_l)
+            ell = jnp.conj(jnp.triu(packed_l[:w])).T       # [w, w] lower
+            newblk = jnp.conj(packed_l).T                  # keep V rows
+            newblk = newblk.at[:, :w].set(ell)
+            a = a.at[k0:k1, k1:].set(newblk)
+            # trailing right update: C <- C conj(Q_l)
+            tr = a[k1:, k1:]
+            tr = jnp.conj(apply_q_right(packed_l, Tl, jnp.conj(tr),
+                                        conj_trans=False))
+            a = a.at[k1:, k1:].set(tr)
+        else:
+            Tl = jnp.zeros((w, w), a.dtype)
+        if w < nb:
+            Tq = jnp.zeros((nb, nb), Tq.dtype).at[:w, :w].set(Tq)
+            Tl = jnp.zeros((nb, nb), Tl.dtype).at[:w, :w].set(Tl)
+        Tqs.append(Tq)
+        Tls.append(Tl)
+    return a, jnp.stack(Tqs), jnp.stack(Tls)
+
+
+def _band_upper_of(a_packed, n: int, kd: int):
+    """Extract the n x n upper band (0 <= j - i <= kd) from ge2tb packing."""
+    sq = a_packed[:n, :n]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(n)[None, :]
+    mask = (j - i >= 0) & (j - i <= kd)
+    return jnp.where(mask, sq, jnp.zeros_like(sq))
+
+
+# ---------------------------------------------------------------- stage 2
+
+def _tb2bd(band, kd: int, want_uv: bool):
+    """Upper band (bandwidth kd) -> real upper bidiagonal (d, e) via
+    alternating right/left bulge-chase reflectors in one lax.scan
+    (ref: tb2bd.cc gebr1/2/3 sweep pipeline).
+
+    Returns (d, e, U2, V2) with band = U2 B V2^H."""
+    n = band.shape[0]
+    dt = band.dtype
+    if n == 1:
+        d = jnp.abs(band[0, 0])[None]
+        eye = jnp.eye(1, dtype=dt)
+        ph = jnp.where(jnp.abs(band[0, 0]) > 0,
+                       band[0, 0] / jnp.where(jnp.abs(band[0, 0]) > 0,
+                                              jnp.abs(band[0, 0]),
+                                              jnp.ones_like(d[0])),
+                       jnp.ones_like(band[0, 0]))
+        return d, jnp.zeros((0,), d.dtype), ph * eye if want_uv else None, \
+            eye if want_uv else None
+    kd = max(1, min(kd, n - 1))
+    off = 2 * kd                                  # top/left padding
+    N = n + 4 * kd + 2
+    A = jnp.zeros((N, N), dt).at[off:off + n, off:off + n].set(band)
+    U = jnp.eye(N, dtype=dt) if want_uv else jnp.zeros((1, 1), dt)
+    V = jnp.eye(N, dtype=dt) if want_uv else jnp.zeros((1, 1), dt)
+
+    Umax = max(1, -(-(n - 1) // kd))              # chase pairs per sweep
+
+    def step(carry, jus):
+        A, U, V = carry
+        j, u = jus
+        # ---- right sub-step: clear row r beyond its first superdiag ----
+        r = jnp.where(u == 0, j, j + 1 + (u - 1) * kd) + off
+        cb = j + 1 + u * kd + off
+        x = lax.dynamic_slice(A, (r, cb), (1, kd))[0]
+        v, tau, _ = householder_vec(x)
+        # cols [cb, cb+kd), rows [cb-kd, cb+kd)
+        Wr = lax.dynamic_slice(A, (cb - kd, cb), (2 * kd, kd))
+        Wr = Wr - tau * (Wr @ v)[:, None] * jnp.conj(v)[None, :]
+        A = lax.dynamic_update_slice(A, Wr, (cb - kd, cb))
+        if want_uv:
+            Vc = lax.dynamic_slice(V, (0, cb), (N, kd))
+            Vc = Vc - tau * (Vc @ v)[:, None] * jnp.conj(v)[None, :]
+            V = lax.dynamic_update_slice(V, Vc, (0, cb))
+        # ---- left sub-step: clear col rb below its diagonal ----
+        rb = j + 1 + u * kd + off
+        x2 = lax.dynamic_slice(A, (rb, rb), (kd, 1))[:, 0]
+        v2, tau2, _ = householder_vec(x2)
+        W2 = lax.dynamic_slice(A, (rb, rb), (kd, 2 * kd + 1))
+        W2 = W2 - jnp.conj(tau2) * v2[:, None] * (jnp.conj(v2) @ W2)[None, :]
+        A = lax.dynamic_update_slice(A, W2, (rb, rb))
+        if want_uv:
+            Uc = lax.dynamic_slice(U, (0, rb), (N, kd))
+            Uc = Uc - tau2 * (Uc @ v2)[:, None] * jnp.conj(v2)[None, :]
+            U = lax.dynamic_update_slice(U, Uc, (0, rb))
+        return (A, U, V), None
+
+    js = jnp.repeat(jnp.arange(n - 1), Umax)
+    us = jnp.tile(jnp.arange(Umax), n - 1)
+    (A, U, V), _ = lax.scan(step, (A, U, V), (js, us))
+
+    sq = A[off:off + n, off:off + n]
+    d_c = jnp.diagonal(sq)
+    e_c = jnp.diagonal(sq, offset=1)
+    U = U[off:off + n, off:off + n] if want_uv else None
+    V = V[off:off + n, off:off + n] if want_uv else None
+
+    # phase-normalise to a real bidiagonal (ref: zbdsqr requires real d, e)
+    if is_complex(dt):
+        def ph(z):
+            az = jnp.abs(z)
+            return jnp.where(az > 0, z / jnp.where(az > 0, az,
+                                                   jnp.ones_like(az)),
+                             jnp.ones_like(z))
+
+        def phase_step(rprev, de):
+            dj, ej = de
+            lj = ph(dj * rprev)                   # makes conj(l) d r real
+            rnext = jnp.conj(ph(jnp.conj(lj) * ej))
+            return rnext, (lj, rnext)
+
+        e_pad = jnp.concatenate([e_c, jnp.ones((1,), dt)])
+        _, (ls, rs) = lax.scan(phase_step, jnp.ones((), dt), (d_c, e_pad))
+        rs = jnp.concatenate([jnp.ones((1,), dt), rs[:-1]])
+        d = jnp.real(jnp.conj(ls) * d_c * rs)
+        e = jnp.real(jnp.conj(ls[:-1]) * e_c * rs[1:])
+        if want_uv:
+            U = U * ls[None, :]
+            V = V * jnp.conj(rs)[None, :]
+    else:
+        d, e = d_c, e_c
+    return d, e, U, V
+
+
+# ---------------------------------------------------------------- driver
+
+def _bd_svd(d, e, want_uv: bool):
+    """Vendor-kernel seam (ref: svd.cc:286 lapack::bdsqr on rank 0): SVD of
+    the assembled bidiagonal via XLA's native svd."""
+    n = d.shape[0]
+    B = jnp.diag(d) + (jnp.diag(e, 1) if n > 1 else 0)
+    if want_uv:
+        Ub, s, Vbh = jnp.linalg.svd(B)
+        return s, Ub, Vbh
+    return jnp.linalg.svd(B, compute_uv=False), None, None
+
+
+def _unmbr_ge2tb_u(a_packed, Tqs, nb: int, Z):
+    """Z <- Q_qr Z (ref: unmbr_ge2tb U side): QR panels descending."""
+    m = a_packed.shape[0]
+    K = Tqs.shape[0]
+    n = min(a_packed.shape[1], K * nb)
+    for idx in range(K - 1, -1, -1):
+        k0 = idx * nb
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        pk = a_packed[k0:, k0:k1]
+        Tk = Tqs[idx][:w, :w]
+        Z = Z.at[k0:, :].set(apply_q_left(pk, Tk, Z[k0:, :],
+                                          conj_trans=False))
+    return Z
+
+
+def _unmbr_ge2tb_v(a_packed, Tls, nb: int, Z):
+    """Z <- M Z with M = prod_k conj(Q_lq_k) (ref: unmbr_ge2tb V side):
+    LQ panels descending; M_k X = conj(Q_lk conj(X))."""
+    n = Z.shape[0]
+    K = Tls.shape[0]
+    for idx in range(K - 1, -1, -1):
+        k0 = idx * nb
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        if k1 >= n:
+            continue
+        pk = jnp.conj(a_packed[k0:k1, k1:]).T         # [(n-k1), w] packed
+        Tk = Tls[idx][:w, :w]
+        Zs = jnp.conj(Z[k1:, :])
+        Zs = apply_q_left(pk, Tk, Zs, conj_trans=False)
+        Z = Z.at[k1:, :].set(jnp.conj(Zs))
+    return Z
+
+
+def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
+    """Singular value decomposition A = U diag(s) V^H (ref: src/svd.cc).
+
+    Returns (s, U, V) with thin U [m, r], V [n, r], r = min(m, n);
+    (s, None, None) when jobu=False.  m < n handled by factoring A^H."""
+    m, n = A.m, A.n
+    if m < n:
+        s, V, U = svd(_conj_t_root(A), opts, jobu=jobu)
+        return s, U, V
+    nb = A.nb
+    ad = A.to_dense()
+    packed, Tqs, Tls = _ge2tb_dense(ad, nb)
+    band = _band_upper_of(packed, n, nb)
+    d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
+    s, Ub, Vbh = _bd_svd(d, e, jobu)
+    if not jobu:
+        return s, None, None
+    Un = U2 @ Ub.astype(U2.dtype)                      # [n, n]
+    Vn = V2 @ jnp.conj(Vbh.astype(V2.dtype)).T         # [n, n]
+    Ufull = jnp.zeros((m, n), packed.dtype).at[:n, :n].set(Un)
+    Ufull = _unmbr_ge2tb_u(packed, Tqs, nb, Ufull)
+    Vfull = _unmbr_ge2tb_v(packed, Tls, nb, Vn)
+    g = A.grid
+    Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
+    Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
+    return s, Um, Vm
+
+
+def svd_vals(A: Matrix, opts: Options | None = None):
+    """Singular values only (ref: simplified_api svd_vals)."""
+    return svd(A, opts, jobu=False)[0]
+
+
+def _conj_t_root(A) -> Matrix:
+    d = jnp.conj(A.to_dense()).T
+    return Matrix(TileStorage.from_dense(d, A.nb, A.mb, A.grid))

@@ -99,6 +99,32 @@ def build_t(packed, taus):
     return lax.fori_loop(0, min(mm, w), body, T0)
 
 
+def householder_vec(x):
+    """One Householder reflector mapping x -> beta e_0 (ref: the larfg
+    kernel used throughout src/internal/internal_gebr.cc / hebr.cc).
+
+    Returns (v, tau, beta): H = I - tau v v^H, v[0] = 1, beta real.
+    Zero (or already-reduced) x yields tau = 0 (identity).
+    """
+    alpha = x[0]
+    rows = jnp.arange(x.shape[0])
+    sigma2 = jnp.sum(jnp.where(rows > 0, jnp.real(x * jnp.conj(x)),
+                               jnp.zeros_like(jnp.real(x))))
+    mu = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + sigma2)
+    real_dt = jnp.real(x).dtype
+    beta = jnp.where(jnp.real(alpha) >= 0, -mu, mu).astype(real_dt)
+    live = mu > 0
+    safe_beta = jnp.where(live, beta, jnp.ones_like(beta))
+    tau = jnp.where(live, (safe_beta - alpha) / safe_beta,
+                    jnp.zeros_like(alpha))
+    scale = jnp.where(live, 1 / jnp.where(live, alpha - safe_beta,
+                                          jnp.ones_like(alpha)),
+                      jnp.zeros_like(alpha))
+    v = jnp.where(rows > 0, x * scale, jnp.zeros_like(x))
+    v = jnp.where(rows == 0, jnp.ones_like(v), v)
+    return v, tau, jnp.where(live, beta, jnp.real(alpha))
+
+
 # ---- larfb: apply the block reflector (ref: internal_unmqr.cc larfb path).
 # Q = I - V T V^H;  Q^H = I - V T^H V^H.
 

@@ -1,0 +1,82 @@
+"""Two-stage eigensolver tests: eigenvalues vs numpy.linalg.eigvalsh and
+||A Z - Z diag(w)|| residuals (analog of ref test/test_heev.cc)."""
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def herm(rng, n, dtype=np.float64):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    return (a + a.conj().T) / 2
+
+
+@pytest.mark.parametrize("n,nb", [(16, 4), (23, 5), (8, 8), (12, 16)])
+def test_heev_values(rng, n, nb):
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
+    w = st.heevd(A)
+    np.testing.assert_allclose(np.sort(np.asarray(w)),
+                               np.linalg.eigvalsh(a), atol=1e-10)
+
+
+@pytest.mark.parametrize("n,nb", [(16, 4), (21, 5)])
+def test_heev_vectors(rng, n, nb):
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
+    w, Z = st.heev(A)
+    w = np.asarray(w)
+    z = Z.to_numpy()
+    np.testing.assert_allclose(z.conj().T @ z, np.eye(n), atol=1e-11)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
+    np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-10)
+
+
+def test_heev_complex(rng):
+    n, nb = 14, 4
+    a = herm(rng, n, np.complex128)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
+    w, Z = st.heev(A)
+    w, z = np.asarray(w), Z.to_numpy()
+    assert np.abs(np.imag(w)).max() == 0        # eigenvalues real
+    np.testing.assert_allclose(z.conj().T @ z, np.eye(n), atol=1e-11)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
+
+
+def test_heev_mesh(rng):
+    n, nb = 20, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    w, Z = st.heev(A)
+    w, z = np.asarray(w), Z.to_numpy()
+    np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-10)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
+
+
+def test_hegv(rng):
+    n, nb = 12, 4
+    a = herm(rng, n)
+    bmat = rng.standard_normal((n, n))
+    b = bmat @ bmat.T + n * np.eye(n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
+    B = st.HermitianMatrix.from_numpy(b, nb, st.Uplo.Lower)
+    w, X = st.hegv(A, B)
+    w, x = np.asarray(w), X.to_numpy()
+    import scipy.linalg
+    wref = scipy.linalg.eigh(a, b, eigvals_only=True)
+    np.testing.assert_allclose(np.sort(w), wref, atol=1e-9)
+    np.testing.assert_allclose(a @ x, b @ x @ np.diag(w), atol=1e-9)
+
+
+def test_heev_uplo_upper(rng):
+    n, nb = 12, 4
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Upper)
+    w = st.heevd(A)
+    np.testing.assert_allclose(np.sort(np.asarray(w)),
+                               np.linalg.eigvalsh(a), atol=1e-10)
