@@ -25,7 +25,6 @@ TPU-first shape:
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -33,7 +32,7 @@ from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.qr import (apply_q_left, build_t, householder_panel,
-                           householder_vec, unit_lower)
+                           householder_vec, phase_of, unit_lower)
 from ..options import Options
 from ..types import Uplo, is_complex
 
@@ -66,13 +65,12 @@ def _he2hb_dense(a, nb: int):
         trail = trail - V @ jnp.conj(W).T - W @ jnp.conj(V).T
         a = a.at[k1:, k1:].set(trail)
         # panel region becomes [R; 0] under Q^H; keep V packed below R
+        # (the mirrored upper block is never read: _band_of rebuilds the
+        # upper triangle from the lower one, _unmtr_he2hb reads only the
+        # subdiagonal panels)
         a = a.at[k1:, k0:k1].set(packed)
-        rtop = jnp.triu(packed[:w])              # [rr, w], rr = min(w, n-k1)
-        mirror = jnp.zeros((w, n - k1), a.dtype)
-        mirror = mirror.at[:, : rtop.shape[0]].set(jnp.conj(rtop).T)
-        a = a.at[k0:k1, k1:].set(mirror)
-        if w < nb:
-            T = jnp.zeros((nb, nb), T.dtype).at[:w, :w].set(T)
+        # w == nb always here (the loop stops before n - nb, and the final
+        # sub-nb remainder stays inside the band), so T needs no padding
         Ts.append(T)
     T_stack = (jnp.stack(Ts) if Ts
                else jnp.zeros((0, nb, nb), a.dtype))
@@ -153,8 +151,13 @@ def _hb2st(band, kd: int, want_q: bool):
             Q = lax.dynamic_update_slice(Q, Qc, (0, b))
         return (A, Q), None
 
-    js = jnp.repeat(jnp.arange(n - 1), Tmax)
-    ts = jnp.tile(jnp.arange(Tmax), n - 1)
+    # static schedule: only the live (sweep, step) pairs — step t of sweep j
+    # touches rows from j+1+t*kd, so later sweeps need fewer chase steps
+    # (the reference's sweep/step progress table encodes the same frontier)
+    pairs = [(j, t) for j in range(n - 1) for t in range(Tmax)
+             if j + 1 + t * kd < n]
+    js = jnp.asarray([pr[0] for pr in pairs])
+    ts = jnp.asarray([pr[1] for pr in pairs])
     (A, Q), _ = lax.scan(step, (A, Q), (js, ts))
 
     d = jnp.real(jnp.diagonal(A)[:n])
@@ -162,17 +165,12 @@ def _hb2st(band, kd: int, want_q: bool):
     if is_complex(dt):
         # phase-normalise the subdiagonal (LAPACK zhbtrd final scaling):
         # T_real = D^H T D, Z gets D folded in
-        mag = jnp.abs(e_c)
-        ph = jnp.where(mag > 0, e_c / jnp.where(mag > 0, mag,
-                                                jnp.ones_like(mag)),
-                       jnp.ones_like(e_c))
-        D = jnp.concatenate([jnp.ones((1,), dt), jnp.cumprod(ph)])
-        e = mag
+        D = jnp.concatenate([jnp.ones((1,), dt), jnp.cumprod(phase_of(e_c))])
+        e = jnp.abs(e_c)
         if want_q:
             Q = Q.at[:, :n].multiply(D[None, :])
     else:
         e = e_c
-        D = None
     return d, e, (Q[:n, :n] if want_q else None)
 
 
@@ -199,6 +197,12 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
     """
     slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
                 "heev: need HermitianMatrix/SymmetricMatrix")
+    # complex-symmetric (non-Hermitian) has no real eigendecomposition of
+    # this form; LAPACK/SLATE likewise have no such driver (ref heev.cc
+    # instantiates syev only for real scalar types)
+    slate_error(isinstance(A, HermitianMatrix) or not is_complex(A.dtype),
+                "heev: complex SymmetricMatrix is not Hermitian — "
+                "no eigensolver for complex-symmetric matrices")
     n = A.m
     nb = A.nb
     ad = A.to_dense()
@@ -215,7 +219,15 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
 
 
 def heevd(A, opts: Options | None = None):
-    """Eigenvalues only (ref: heev with Job::NoVec)."""
+    """Eigenvalues AND vectors, divide-and-conquer flavor — the LAPACK
+    heevd contract (our tridiagonal seam is XLA's eigh, itself D&C/QDWH;
+    ref: heev.cc MethodEig::DC default).  Same result as heev(A)."""
+    return heev(A, opts, jobz=True)
+
+
+def heev_vals(A, opts: Options | None = None):
+    """Eigenvalues only (ref: heev with Job::NoVec; simplified_api
+    eig_vals).  Values-only twin of svd_vals."""
     return heev(A, opts, jobz=False)[0]
 
 

@@ -19,7 +19,6 @@ TPU-first shape mirrors drivers/heev.py:
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -27,7 +26,7 @@ from ..core.matrix import Matrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.qr import (apply_q_left, apply_q_right, build_t,
-                           householder_panel, householder_vec, unit_lower)
+                           householder_panel, householder_vec, phase_of)
 from ..options import Options
 from ..types import is_complex
 
@@ -58,14 +57,18 @@ def _ge2tb_dense(a, nb: int):
             blk = a[k0:k1, k1:]
             packed_l, taus_l = householder_panel(jnp.conj(blk).T)
             Tl = build_t(packed_l, taus_l)
-            ell = jnp.conj(jnp.triu(packed_l[:w])).T       # [w, w] lower
-            newblk = jnp.conj(packed_l).T                  # keep V rows
-            newblk = newblk.at[:, :w].set(ell)
+            # merge L (on/below the diagonal) with the reflector rows kept
+            # strictly above it — LAPACK gelqf packing: overwriting the
+            # whole leading w x w block would clobber the v entries there
+            ell = jnp.conj(jnp.triu(packed_l)).T           # [w, nk] lower trap
+            vrows = jnp.conj(packed_l).T                   # [w, nk]
+            iw = jnp.arange(w)[:, None]
+            jk = jnp.arange(a.shape[1] - k1)[None, :]
+            newblk = jnp.where(jk <= iw, ell, vrows)
             a = a.at[k0:k1, k1:].set(newblk)
-            # trailing right update: C <- C conj(Q_l)
-            tr = a[k1:, k1:]
-            tr = jnp.conj(apply_q_right(packed_l, Tl, jnp.conj(tr),
-                                        conj_trans=False))
+            # trailing right update: C <- C Q_l  (blk = R^H Q_l^H, so
+            # right-multiplying by Q_l yields [L 0] with L = R^H)
+            tr = apply_q_right(packed_l, Tl, a[k1:, k1:], conj_trans=False)
             a = a.at[k1:, k1:].set(tr)
         else:
             Tl = jnp.zeros((w, w), a.dtype)
@@ -99,11 +102,7 @@ def _tb2bd(band, kd: int, want_uv: bool):
     if n == 1:
         d = jnp.abs(band[0, 0])[None]
         eye = jnp.eye(1, dtype=dt)
-        ph = jnp.where(jnp.abs(band[0, 0]) > 0,
-                       band[0, 0] / jnp.where(jnp.abs(band[0, 0]) > 0,
-                                              jnp.abs(band[0, 0]),
-                                              jnp.ones_like(d[0])),
-                       jnp.ones_like(band[0, 0]))
+        ph = phase_of(band[0, 0])
         return d, jnp.zeros((0,), d.dtype), ph * eye if want_uv else None, \
             eye if want_uv else None
     kd = max(1, min(kd, n - 1))
@@ -121,8 +120,10 @@ def _tb2bd(band, kd: int, want_uv: bool):
         # ---- right sub-step: clear row r beyond its first superdiag ----
         r = jnp.where(u == 0, j, j + 1 + (u - 1) * kd) + off
         cb = j + 1 + u * kd + off
+        # row-clearing by RIGHT multiplication: build the reflector from
+        # conj(row) so that x H = beta e1^T (column-semantics householder_vec)
         x = lax.dynamic_slice(A, (r, cb), (1, kd))[0]
-        v, tau, _ = householder_vec(x)
+        v, tau, _ = householder_vec(jnp.conj(x))
         # cols [cb, cb+kd), rows [cb-kd, cb+kd)
         Wr = lax.dynamic_slice(A, (cb - kd, cb), (2 * kd, kd))
         Wr = Wr - tau * (Wr @ v)[:, None] * jnp.conj(v)[None, :]
@@ -144,8 +145,12 @@ def _tb2bd(band, kd: int, want_uv: bool):
             U = lax.dynamic_update_slice(U, Uc, (0, rb))
         return (A, U, V), None
 
-    js = jnp.repeat(jnp.arange(n - 1), Umax)
-    us = jnp.tile(jnp.arange(Umax), n - 1)
+    # static schedule: only live (sweep, chase-pair) steps — pair u of sweep
+    # j starts at column j+1+u*kd, so later sweeps need fewer pairs
+    pairs = [(j, u) for j in range(n - 1) for u in range(Umax)
+             if j + 1 + u * kd < n]
+    js = jnp.asarray([pr[0] for pr in pairs])
+    us = jnp.asarray([pr[1] for pr in pairs])
     (A, U, V), _ = lax.scan(step, (A, U, V), (js, us))
 
     sq = A[off:off + n, off:off + n]
@@ -156,16 +161,10 @@ def _tb2bd(band, kd: int, want_uv: bool):
 
     # phase-normalise to a real bidiagonal (ref: zbdsqr requires real d, e)
     if is_complex(dt):
-        def ph(z):
-            az = jnp.abs(z)
-            return jnp.where(az > 0, z / jnp.where(az > 0, az,
-                                                   jnp.ones_like(az)),
-                             jnp.ones_like(z))
-
         def phase_step(rprev, de):
             dj, ej = de
-            lj = ph(dj * rprev)                   # makes conj(l) d r real
-            rnext = jnp.conj(ph(jnp.conj(lj) * ej))
+            lj = phase_of(dj * rprev)             # makes conj(l) d r real
+            rnext = jnp.conj(phase_of(jnp.conj(lj) * ej))
             return rnext, (lj, rnext)
 
         e_pad = jnp.concatenate([e_c, jnp.ones((1,), dt)])
@@ -174,8 +173,9 @@ def _tb2bd(band, kd: int, want_uv: bool):
         d = jnp.real(jnp.conj(ls) * d_c * rs)
         e = jnp.real(jnp.conj(ls[:-1]) * e_c * rs[1:])
         if want_uv:
+            # band = (U L) B_real (V R)^H with L = diag(ls), R = diag(rs)
             U = U * ls[None, :]
-            V = V * jnp.conj(rs)[None, :]
+            V = V * rs[None, :]
     else:
         d, e = d_c, e_c
     return d, e, U, V
@@ -211,8 +211,9 @@ def _unmbr_ge2tb_u(a_packed, Tqs, nb: int, Z):
 
 
 def _unmbr_ge2tb_v(a_packed, Tls, nb: int, Z):
-    """Z <- M Z with M = prod_k conj(Q_lq_k) (ref: unmbr_ge2tb V side):
-    LQ panels descending; M_k X = conj(Q_lk conj(X))."""
+    """Z <- V1 Z with V1 = W_0 W_1 ... (ref: unmbr_ge2tb V side):
+    A = U1 Band V1^H where each W_k = Q_lq_k acts on rows k1: (the LQ
+    reflectors stored conjugated strictly above the band's L block)."""
     n = Z.shape[0]
     K = Tls.shape[0]
     for idx in range(K - 1, -1, -1):
@@ -223,9 +224,8 @@ def _unmbr_ge2tb_v(a_packed, Tls, nb: int, Z):
             continue
         pk = jnp.conj(a_packed[k0:k1, k1:]).T         # [(n-k1), w] packed
         Tk = Tls[idx][:w, :w]
-        Zs = jnp.conj(Z[k1:, :])
-        Zs = apply_q_left(pk, Tk, Zs, conj_trans=False)
-        Z = Z.at[k1:, :].set(jnp.conj(Zs))
+        Zs = apply_q_left(pk, Tk, Z[k1:, :], conj_trans=False)
+        Z = Z.at[k1:, :].set(Zs)
     return Z
 
 
@@ -234,6 +234,9 @@ def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
 
     Returns (s, U, V) with thin U [m, r], V [n, r], r = min(m, n);
     (s, None, None) when jobu=False.  m < n handled by factoring A^H."""
+    slate_error(type(A) is Matrix,
+                "svd: need a general Matrix (convert structured types "
+                "with .general())")
     m, n = A.m, A.n
     if m < n:
         s, V, U = svd(_conj_t_root(A), opts, jobu=jobu)

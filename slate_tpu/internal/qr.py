@@ -21,6 +21,34 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _larfg(alpha, x):
+    """larfg scalar core shared by the panel loop and householder_vec: given
+    the pivot ``alpha`` and the tail ``x`` (entries outside the tail MUST
+    already be zeroed), return (tau, beta, scale, live).
+
+    beta = -copysign(mu, Re(alpha)); ``live`` False (identity reflector,
+    tau = 0) when mu == 0."""
+    real_dt = jnp.real(x).dtype
+    sigma2 = jnp.sum(jnp.real(x * jnp.conj(x)))
+    mu = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + sigma2)
+    beta = jnp.where(jnp.real(alpha) >= 0, -mu, mu).astype(real_dt)
+    live = mu > 0
+    safe_beta = jnp.where(live, beta, jnp.ones_like(beta))
+    tau = jnp.where(live, (safe_beta - alpha) / safe_beta,
+                    jnp.zeros_like(alpha))
+    scale = jnp.where(live, 1 / jnp.where(live, alpha - safe_beta,
+                                          jnp.ones_like(alpha)),
+                      jnp.zeros_like(alpha))
+    return tau, beta, scale, live
+
+
+def phase_of(z):
+    """z / |z| elementwise, with phase 1 where z == 0 (safe division)."""
+    az = jnp.abs(z)
+    return jnp.where(az > 0, z / jnp.where(az > 0, az, jnp.ones_like(az)),
+                     jnp.ones_like(z))
+
+
 def householder_panel(a):
     """Householder QR of a panel ``a`` [mm, w] (mm >= 1, any w).
 
@@ -31,24 +59,13 @@ def householder_panel(a):
     r = min(mm, w)
     rows = jnp.arange(mm)
     cols = jnp.arange(w)
-    real_dt = jnp.real(a).dtype
 
     def body(j, carry):
         a, taus = carry
         colj = lax.dynamic_index_in_dim(a, j, axis=1, keepdims=False)
         alpha = lax.dynamic_index_in_dim(colj, j, axis=0, keepdims=False)
         x = jnp.where(rows > j, colj, jnp.zeros_like(colj))
-        sigma2 = jnp.sum(jnp.real(x * jnp.conj(x)))
-        mu = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + sigma2)
-        # beta = -copysign(mu, Re(alpha)); identity reflector when mu == 0
-        beta = jnp.where(jnp.real(alpha) >= 0, -mu, mu).astype(real_dt)
-        live = mu > 0
-        safe_beta = jnp.where(live, beta, jnp.ones_like(beta))
-        tau = jnp.where(live, (safe_beta - alpha) / safe_beta,
-                        jnp.zeros_like(alpha))
-        scale = jnp.where(live, 1 / jnp.where(live, alpha - safe_beta,
-                                              jnp.ones_like(alpha)),
-                          jnp.zeros_like(alpha))
+        tau, beta, scale, live = _larfg(alpha, x)
         v = jnp.where(rows > j, x * scale, jnp.zeros_like(x))
         v = jnp.where(rows == j, jnp.ones_like(v), v)
         # trailing update: a[:, j+1:] -= conj(tau) v (v^H a[:, j+1:])
@@ -108,19 +125,9 @@ def householder_vec(x):
     """
     alpha = x[0]
     rows = jnp.arange(x.shape[0])
-    sigma2 = jnp.sum(jnp.where(rows > 0, jnp.real(x * jnp.conj(x)),
-                               jnp.zeros_like(jnp.real(x))))
-    mu = jnp.sqrt(jnp.real(alpha * jnp.conj(alpha)) + sigma2)
-    real_dt = jnp.real(x).dtype
-    beta = jnp.where(jnp.real(alpha) >= 0, -mu, mu).astype(real_dt)
-    live = mu > 0
-    safe_beta = jnp.where(live, beta, jnp.ones_like(beta))
-    tau = jnp.where(live, (safe_beta - alpha) / safe_beta,
-                    jnp.zeros_like(alpha))
-    scale = jnp.where(live, 1 / jnp.where(live, alpha - safe_beta,
-                                          jnp.ones_like(alpha)),
-                      jnp.zeros_like(alpha))
-    v = jnp.where(rows > 0, x * scale, jnp.zeros_like(x))
+    tail = jnp.where(rows > 0, x, jnp.zeros_like(x))
+    tau, beta, scale, live = _larfg(alpha, tail)
+    v = jnp.where(rows > 0, tail * scale, jnp.zeros_like(x))
     v = jnp.where(rows == 0, jnp.ones_like(v), v)
     return v, tau, jnp.where(live, beta, jnp.real(alpha))
 

@@ -1,0 +1,65 @@
+"""SVD tests: singular values vs numpy and ||A - U S V^H|| residuals
+(analog of ref test/test_svd.cc residual + ortho checks)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def _mat(rng, m, n, dtype=np.float64):
+    a = rng.standard_normal((m, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((m, n))
+    return a
+
+
+@pytest.mark.parametrize("m,n,nb", [(16, 16, 4), (24, 13, 5), (13, 24, 5),
+                                    (8, 8, 8), (30, 7, 4)])
+def test_svd_values(rng, m, n, nb):
+    a = _mat(rng, m, n)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    s = st.svd_vals(A)
+    np.testing.assert_allclose(np.asarray(s), np.linalg.svd(a, compute_uv=False),
+                               atol=1e-10)
+
+
+@pytest.mark.parametrize("m,n,nb", [(16, 16, 4), (20, 11, 5), (11, 20, 5)])
+def test_svd_vectors(rng, m, n, nb):
+    a = _mat(rng, m, n)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    s, U, V = st.svd(A)
+    s = np.asarray(s)
+    u = U.to_numpy()
+    v = V.to_numpy()
+    r = min(m, n)
+    np.testing.assert_allclose(u.conj().T @ u, np.eye(u.shape[1]), atol=1e-11)
+    np.testing.assert_allclose(v.conj().T @ v, np.eye(v.shape[1]), atol=1e-11)
+    np.testing.assert_allclose(u[:, :r] * s[None, :r] @ v[:, :r].conj().T, a,
+                               atol=1e-10)
+    np.testing.assert_allclose(s[:r], np.linalg.svd(a, compute_uv=False),
+                               atol=1e-10)
+
+
+def test_svd_complex(rng):
+    m, n, nb = 14, 10, 4
+    a = _mat(rng, m, n, np.complex128)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    s, U, V = st.svd(A)
+    s = np.asarray(s)
+    u, v = U.to_numpy(), V.to_numpy()
+    np.testing.assert_allclose(u * s[None, :] @ v.conj().T, a, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(st.svd_vals(A)),
+                               np.linalg.svd(a, compute_uv=False), atol=1e-10)
+
+
+def test_svd_mesh_grid(rng):
+    # distributed storage in, gathered two-stage reduction (ref svd.cc
+    # gathers the band the same way, ge2tbGather)
+    m = n = 16
+    g = st.make_grid(4)
+    a = _mat(rng, m, n)
+    A = st.Matrix.from_numpy(a, 4, 4, g)
+    s = st.svd_vals(A)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.linalg.svd(a, compute_uv=False), atol=1e-10)
