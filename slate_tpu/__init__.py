@@ -45,7 +45,13 @@ from .drivers.qr import (  # noqa: F401
     CAQRFactors, LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,
     gels_qr, geqrf, qr_multiply, unmlq, unmqr,
 )
+from .drivers.band import (  # noqa: F401
+    GBFactors, PBFactors, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf,
+    pbtrs, tbsm,
+)
 from .drivers.heev import heev, heev_vals, heevd, hegst, hegv  # noqa: F401
+from .drivers.condest import gecondest, norm1est, trcondest  # noqa: F401
+from .drivers.hetrf import HEFactors, hesv, hetrf, hetrs  # noqa: F401
 from .drivers.svd import svd, svd_vals  # noqa: F401
 from .drivers.mixed import (  # noqa: F401
     MixedResult, gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,

@@ -431,6 +431,13 @@ class BandMatrix(BaseBandMatrix):
 class TriangularBandMatrix(BaseBandMatrix):
     """ref: include/slate/TriangularBandMatrix.hh"""
 
+    @classmethod
+    def from_numpy(cls, a, kd, mb, uplo: Uplo = Uplo.Lower,
+                   diag: Diag = Diag.NonUnit, grid=None):
+        st = TileStorage.from_dense(jnp.asarray(a), mb, mb,
+                                    grid or Grid(1, 1))
+        return cls(st, kd=kd, uplo=uplo, diag=diag)
+
     def __init__(self, storage, kd: int = 0, uplo: Uplo = Uplo.Lower,
                  diag: Diag = Diag.NonUnit, **kw):
         kl, ku = (kd, 0) if uplo is Uplo.Lower else (0, kd)
@@ -456,6 +463,12 @@ class TriangularBandMatrix(BaseBandMatrix):
 @jax.tree_util.register_pytree_node_class
 class HermitianBandMatrix(BaseBandMatrix):
     """ref: include/slate/HermitianBandMatrix.hh"""
+
+    @classmethod
+    def from_numpy(cls, a, kd, mb, uplo: Uplo = Uplo.Lower, grid=None):
+        st = TileStorage.from_dense(jnp.asarray(a), mb, mb,
+                                    grid or Grid(1, 1))
+        return cls(st, kd=kd, uplo=uplo)
 
     def __init__(self, storage, kd: int = 0, uplo: Uplo = Uplo.Lower, **kw):
         kl, ku = (kd, 0) if uplo is Uplo.Lower else (0, kd)

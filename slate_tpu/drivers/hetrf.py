@@ -1,0 +1,169 @@
+"""Symmetric-indefinite solvers: hetrf / hetrs / hesv (Aasen).
+
+Analog of the reference's Aasen chain (ref: src/hetrf.cc:1-619 — Aasen's
+factorization P A P^H = L T L^H with L unit lower triangular, first column
+e_0, and T a band matrix solved by band LU; src/hetrs.cc applies
+L / T / L^H in sequence; src/hesv.cc drives both).
+
+TPU-first shape: the factorization is ONE lax.fori_loop over columns — each
+step is a full-height gemv against the accumulated L (H = T L^H recurrence,
+Higham ASNA ch. 11 formulation), a masked argmax pivot, and two masked row
+writes.  Static shapes throughout; pivoting is tracked as a permutation
+vector (symmetric row+column gather, never a materialized P A P^H).  The
+tridiagonal T solve reuses the pivoted band LU (internal/band.py, kl=ku=1)
+— the same "solve T by band LU" choice the reference makes (hetrf.cc
+factors T with gbtrf).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+from ..internal.band import gbtrf_banded, gbtrs_banded
+from ..options import Options
+from ..types import is_complex
+
+
+class HEFactors(NamedTuple):
+    """Aasen factors: P A P^H = L T L^H.  ``L`` dense unit-lower [n, n]
+    (column 0 = e_0), ``d`` real diagonal of T, ``e`` subdiagonal of T,
+    ``piv`` the row/column permutation (A[piv][:, piv] = L T L^H)."""
+    L: jax.Array
+    d: jax.Array
+    e: jax.Array
+    piv: jax.Array
+
+
+def _aasen(a):
+    """Scalar Aasen with partial pivoting on a dense Hermitian matrix
+    (both triangles populated).  Returns (L, d, e, piv)."""
+    n = a.shape[0]
+    dt = a.dtype
+    rdt = jnp.real(a).dtype
+    idx = jnp.arange(n)
+
+    L0 = jnp.zeros((n, n), dt).at[:, 0].set(
+        jnp.zeros((n,), dt).at[0].set(1))
+    d0 = jnp.zeros((n,), rdt)
+    e0 = jnp.zeros((n,), dt)                      # e[j] = T[j+1, j]
+    piv0 = idx
+
+    def body(j, carry):
+        L, d, e, piv = carry
+        # permuted column j of A: A[piv, piv[j]]
+        pj = jnp.take(piv, j)
+        acol = jnp.take(a[:, :], pj, axis=1)
+        acol = jnp.take(acol, piv, axis=0)        # [n]
+
+        # H[k, j] = e[k-1] conj(L[j,k-1]) + d[k] conj(L[j,k])
+        #           + conj(e[k]) conj(L[j,k+1]),  for k < j
+        lrow = jnp.conj(jnp.take(L, j, axis=0))   # conj(L[j, :])
+        lm1 = jnp.concatenate([jnp.zeros((1,), dt), lrow[:-1]])
+        lp1 = jnp.concatenate([lrow[1:], jnp.zeros((1,), dt)])
+        em1 = jnp.concatenate([jnp.zeros((1,), dt), e[:-1]])
+        h = em1 * lm1 + d.astype(dt) * lrow + jnp.conj(e) * lp1
+        h = jnp.where(idx < j, h, jnp.zeros_like(h))
+
+        w = acol - L @ h                          # [n] gemv (the hot op)
+        hj = jnp.take(w, j)
+        ljm1 = jnp.take(lm1, j)                   # conj(L[j, j-1])
+        ejm1 = jnp.take(em1, j)                   # e[j-1]
+        dj = hj - ejm1 * ljm1
+        d = d.at[j].set(jnp.real(dj) if is_complex(dt) else dj.astype(rdt))
+
+        r = w - jnp.take(L, j, axis=1) * hj
+        r = jnp.where(idx > j, r, jnp.zeros_like(r))
+
+        # pivot: largest |r| among rows > j; swap rows j+1 <-> p
+        live = j + 1 < n
+        jp1 = jnp.minimum(j + 1, n - 1)
+        p = jnp.argmax(jnp.where(idx > j, jnp.abs(r),
+                                 -jnp.ones_like(jnp.abs(r))))
+        p = jnp.where(live, p, jp1)
+
+        def swap_vec(v):
+            vj, vp = jnp.take(v, jp1), jnp.take(v, p)
+            return v.at[jp1].set(vp).at[p].set(vj)
+
+        r = swap_vec(r)
+        piv_new = swap_vec(piv)
+        rowj, rowp = jnp.take(L, jp1, axis=0), jnp.take(L, p, axis=0)
+        L_sw = L.at[jp1].set(rowp).at[p].set(rowj)
+
+        ej = jnp.take(r, jp1)
+        safe = jnp.where(jnp.abs(ej) > 0, ej, jnp.ones_like(ej))
+        newcol = jnp.where(idx > j + 1, r / safe, jnp.zeros_like(r))
+        newcol = newcol.at[jp1].set(jnp.ones((), dt))
+        e_new = e.at[j].set(jnp.where(live, ej, jnp.zeros_like(ej)))
+        Lcol = jnp.where(live, newcol, jnp.take(L_sw, jp1, axis=1))
+        L_new = L_sw.at[:, jp1].set(Lcol)
+
+        L = jnp.where(live, L_new, L)
+        piv = jnp.where(live, piv_new, piv)
+        e = jnp.where(live, e_new, e)
+        return L, d, e, piv
+
+    L, d, e, piv = lax.fori_loop(0, n, body, (L0, d0, e0, piv0))
+    return L, d, e[: max(n - 1, 0)], piv
+
+
+def hetrf(A, opts: Options | None = None) -> HEFactors:
+    """Aasen factorization of a Hermitian indefinite matrix
+    (ref: src/hetrf.cc).  Returns HEFactors."""
+    slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
+                "hetrf: need HermitianMatrix/SymmetricMatrix")
+    slate_error(isinstance(A, HermitianMatrix) or not is_complex(A.dtype),
+                "hetrf: complex SymmetricMatrix unsupported (use "
+                "HermitianMatrix)")
+    ad = A.to_dense()
+    L, d, e, piv = _aasen(ad)
+    return HEFactors(L, d, e, piv)
+
+
+def _tridiag_solve_piv(d, e, b):
+    """Pivoted solve of the Hermitian tridiagonal T (diagonal d, subdiag e)
+    against b — via the in-house band LU with kl = ku = 1 (stable for
+    indefinite T, unlike the Thomas algorithm)."""
+    n = d.shape[0]
+    dt = jnp.result_type(d.dtype, e.dtype if e.size else d.dtype, b.dtype)
+    gp = jnp.zeros((3, n), dt)
+    gp = gp.at[1].set(d.astype(dt))
+    if n > 1:
+        gp = gp.at[2, :-1].set(e.astype(dt))      # sub: A[j+1, j] at col j
+        gp = gp.at[0, 1:].set(jnp.conj(e).astype(dt))   # super at col j+1
+    work = jnp.zeros((4, n), dt).at[1:].set(gp)   # +kl fill row on top
+    w = min(8, max(n, 1))
+    lu, perms = gbtrf_banded(work, 1, 1, n, w)
+    return gbtrs_banded(lu, perms, 1, 1, n, w, b.astype(dt))
+
+
+def hetrs(F: HEFactors, B, opts: Options | None = None):
+    """Solve from Aasen factors (ref: src/hetrs.cc):
+    x = P^H L^-H T^-1 L^-1 P b."""
+    b = B.to_dense() if isinstance(B, Matrix) else jnp.asarray(B)
+    bp = jnp.take(b, F.piv, axis=0)
+    z = lax.linalg.triangular_solve(F.L, bp, left_side=True, lower=True,
+                                    unit_diagonal=True)
+    y = _tridiag_solve_piv(F.d, F.e, z)
+    wv = lax.linalg.triangular_solve(F.L, y.astype(F.L.dtype),
+                                     left_side=True, lower=True,
+                                     transpose_a=True, conjugate_a=True,
+                                     unit_diagonal=True)
+    x = jnp.zeros_like(wv).at[F.piv].set(wv)
+    if isinstance(B, Matrix):
+        return Matrix(TileStorage.from_dense(x, B.mb, B.nb, B.grid))
+    return x
+
+
+def hesv(A, B, opts: Options | None = None):
+    """Solve A X = B for Hermitian indefinite A (ref: src/hesv.cc).
+    Returns (HEFactors, X)."""
+    F = hetrf(A, opts)
+    return F, hetrs(F, B, opts)

@@ -1,0 +1,347 @@
+"""Packed-band kernels: storage conversion + blocked band factor/solve.
+
+Analog of the reference's band internals (ref: src/gbtrf.cc:1-318 block-column
+LU restricted to in-band tiles, src/pbtrf.cc:1-241 band Cholesky,
+src/tbsm.cc band triangular solve with pivots, src/gbmm.cc / src/hbmm.cc
+band multiply).  The reference keeps band matrices as block-cyclic tiles and
+simply never inserts out-of-band tiles; a TPU-first design instead keeps the
+band in LAPACK-style *packed* storage — a dense ``[bandwidth+1, n]`` array —
+and runs every algorithm as a ``lax.scan`` over block columns with
+STATICALLY-shaped dense windows gathered from / scattered to the packed
+array.  All the O(n·kd²) flops land in MXU-shaped dense blocks; compile time
+is O(1) in n (one scan body per routine).
+
+Packed layouts (LAPACK conventions):
+- Hermitian/lower-triangular band, bandwidth kd:  ``Lp[i, j] = A[j+i, j]``
+  for ``0 <= i <= kd`` (shape ``[kd+1, n]``).
+- General band kl/ku: ``P[ku+i-j, j] = A[i, j]`` (shape ``[kl+ku+1, n]``).
+- gbtrf working array: ``[2kl+ku+1, n]`` — kl extra TOP rows hold the U
+  fill-in from partial pivoting (U bandwidth grows to kl+ku), exactly
+  LAPACK's dgbtrf ldab layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# ------------------------------------------------------------- conversions
+
+def dense_to_banded(a, kl: int, ku: int):
+    """Dense [m, n] -> general packed band [kl+ku+1, n]."""
+    m, n = a.shape
+    r = jnp.arange(kl + ku + 1)[:, None]
+    j = jnp.arange(n)[None, :]
+    i = j + (r - ku)
+    valid = (i >= 0) & (i < m)
+    return jnp.where(valid, a[jnp.clip(i, 0, m - 1), j], 0)
+
+
+def banded_to_dense(p, kl: int, ku: int, m: int, n: int):
+    """General packed band [kl+ku+1, n] -> dense [m, n]."""
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    r = ku + i - j
+    valid = (r >= 0) & (r <= kl + ku)
+    return jnp.where(valid, p[jnp.clip(r, 0, kl + ku), j],
+                     jnp.zeros((), p.dtype))
+
+
+def band_transpose(p, kl: int, ku: int, n: int, conj: bool = False):
+    """Packed band of op(A) from packed band of A (m == n):
+    T[rt, c] = P[kl+ku-rt, c+rt-kl]; result has (kl', ku') = (ku, kl)."""
+    nr = kl + ku + 1
+    rt = jnp.arange(nr)[:, None]
+    c = jnp.arange(n)[None, :]
+    src_r = kl + ku - rt
+    src_c = c + rt - kl
+    valid = (src_c >= 0) & (src_c < n)
+    out = jnp.where(valid, p[src_r, jnp.clip(src_c, 0, n - 1)],
+                    jnp.zeros((), p.dtype))
+    return jnp.conj(out) if conj else out
+
+
+def hermitian_band_expand(lp, kd: int, n: int):
+    """Lower Hermitian packed [kd+1, n] -> general packed [2kd+1, n]
+    (ku = kl = kd), mirroring the strictly-lower part conjugated."""
+    up = band_transpose(lp, kd, 0, n, conj=True)   # [kd+1, n], (kl,ku)=(0,kd)
+    g = jnp.zeros((2 * kd + 1, n), lp.dtype)
+    g = g.at[kd:].set(lp)                          # rows kd..2kd: lower diags
+    g = g.at[:kd + 1].add(up)                      # rows 0..kd: upper diags
+    g = g.at[kd].add(-lp[0])                       # diagonal counted twice
+    return g
+
+
+# --------------------------------------------------------- window gather/scatter
+
+def _gather_window(strip, kl: int, ku: int, Wr: int, Wc: int):
+    """Dense window W[r, c] = strip[ku + r - c, c] from a packed strip
+    [kl+ku+1, Wc] (band entries; out-of-band = 0)."""
+    r = jnp.arange(Wr)[:, None]
+    c = jnp.arange(Wc)[None, :]
+    rr = ku + r - c
+    valid = (rr >= 0) & (rr <= kl + ku)
+    return jnp.where(valid, strip[jnp.clip(rr, 0, kl + ku), c],
+                     jnp.zeros((), strip.dtype))
+
+
+def _scatter_window(strip, w_new, kl: int, ku: int):
+    """Inverse of _gather_window: write dense window values back into the
+    packed strip (only in-band positions)."""
+    nr, Wc = strip.shape
+    Wr = w_new.shape[0]
+    rr = jnp.arange(nr)[:, None]
+    c = jnp.arange(Wc)[None, :]
+    r = c + (rr - ku)
+    valid = (r >= 0) & (r < Wr)
+    return jnp.where(valid, w_new[jnp.clip(r, 0, Wr - 1), c], strip)
+
+
+# ------------------------------------------------------------- pbtrf / pbtrs
+
+def pbtrf_banded(lp, kd: int, n: int, w: int):
+    """Blocked band Cholesky of a Hermitian positive-definite band matrix in
+    lower packed storage [kd+1, n] -> packed L (ref: src/pbtrf.cc potrf +
+    trsm + herk block-column sweep).  ``w`` is the block width.
+
+    One lax.scan over ceil(n/w) block columns; each step factors a
+    (w+kd)x(w+kd) dense window: potrf(W11), L21 = A21 L11^-H, W22 -= L21
+    L21^H — all MXU-shaped."""
+    nblk = -(-n // w)
+    n_pad = nblk * w + kd
+    dt = lp.dtype
+    lpp = jnp.zeros((kd + 1, n_pad), dt).at[:, :n].set(lp[:, :n])
+    # pad columns: unit diagonal so the pad block factors to identity
+    lpp = lpp.at[0, n:].set(jnp.ones((), dt))
+    sz = w + kd
+
+    def step(carry, k):
+        lpp = carry
+        k0 = k * w
+        strip = lax.dynamic_slice(lpp, (0, k0), (kd + 1, sz))
+        W = _gather_window(strip, kd, 0, sz, sz)
+        # Hermitian-complete the lower-only window (XLA's cholesky reads
+        # the full matrix on some backends)
+        w11 = W[:w, :w]
+        w11 = w11 + jnp.conj(jnp.tril(w11, -1)).T
+        l11 = lax.linalg.cholesky(w11)
+        l21 = lax.linalg.triangular_solve(
+            l11, W[w:, :w], left_side=False, lower=True,
+            transpose_a=True, conjugate_a=True)
+        w22 = W[w:, w:] - l21 @ jnp.conj(l21).T
+        Wn = jnp.zeros_like(W)
+        Wn = Wn.at[:w, :w].set(jnp.tril(l11))
+        Wn = Wn.at[w:, :w].set(l21)
+        Wn = Wn.at[w:, w:].set(jnp.tril(w22))
+        strip = _scatter_window(strip, Wn, kd, 0)
+        lpp = lax.dynamic_update_slice(lpp, strip, (0, k0))
+        return lpp, None
+
+    lpp, _ = lax.scan(step, lpp, jnp.arange(nblk))
+    return lpp[:, :n]
+
+
+def banded_trsm_lower(lp, kd: int, n: int, w: int, b, *,
+                      conj_trans: bool = False, unit_diag: bool = False):
+    """Solve L X = b (or L^H X = b when conj_trans) with L lower band in
+    packed storage; b [n, nrhs].  Blocked forward (or backward)
+    substitution as one lax.scan with (w+kd)-row windows."""
+    nblk = -(-n // w)
+    n_pad = nblk * w + kd
+    dt = b.dtype
+    nrhs = b.shape[1]
+    lpp = jnp.zeros((kd + 1, n_pad), lp.dtype).at[:, :n].set(lp[:, :n])
+    lpp = lpp.at[0, n:].set(jnp.ones((), lp.dtype))
+    bp = jnp.zeros((n_pad, nrhs), dt).at[:n].set(b)
+    sz = w + kd
+
+    def get_l(k0):
+        strip = lax.dynamic_slice(lpp, (0, k0), (kd + 1, sz))
+        W = _gather_window(strip, kd, 0, sz, sz)
+        return W[:w, :w], W[w:, :w]                # L11, L21
+
+    if not conj_trans:
+        def fstep(bp, k):
+            k0 = k * w
+            l11, l21 = get_l(k0)
+            bw = lax.dynamic_slice(bp, (k0, 0), (sz, nrhs))
+            y = lax.linalg.triangular_solve(
+                l11, bw[:w], left_side=True, lower=True,
+                unit_diagonal=unit_diag)
+            rest = bw[w:] - l21 @ y
+            bw = bw.at[:w].set(y).at[w:].set(rest)
+            return lax.dynamic_update_slice(bp, bw, (k0, 0)), None
+        bp, _ = lax.scan(fstep, bp, jnp.arange(nblk))
+    else:
+        def bstep(bp, k):
+            k0 = k * w
+            l11, l21 = get_l(k0)
+            bw = lax.dynamic_slice(bp, (k0, 0), (sz, nrhs))
+            rhs = bw[:w] - jnp.conj(l21).T @ bw[w:]
+            y = lax.linalg.triangular_solve(
+                l11, rhs, left_side=True, lower=True, transpose_a=True,
+                conjugate_a=True, unit_diagonal=unit_diag)
+            bw = bw.at[:w].set(y)
+            return lax.dynamic_update_slice(bp, bw, (k0, 0)), None
+        bp, _ = lax.scan(bstep, bp, jnp.arange(nblk - 1, -1, -1))
+    return bp[:n]
+
+
+def pbtrs_banded(lp, kd: int, n: int, w: int, b):
+    """Solve A X = b from pbtrf's packed L: L (L^H X) = b."""
+    y = banded_trsm_lower(lp, kd, n, w, b)
+    return banded_trsm_lower(lp, kd, n, w, y, conj_trans=True)
+
+
+# ------------------------------------------------------------- gbtrf / gbtrs
+
+def gbtrf_banded(gp, kl: int, ku: int, n: int, w: int):
+    """Blocked band LU with partial pivoting (ref: src/gbtrf.cc).
+
+    ``gp`` is the [2kl+ku+1, n] input array (initial band in rows
+    kl..2kl+ku, top kl rows zero fill space).  Returns (gp_factored, perms):
+    the factored array has kl+w-1 multiplier rows below the diagonal —
+    in-panel pivoting can displace rows downward within the (w+kl)-row
+    window, leaving L multipliers up to w-1 diagonals below the kl band
+    (LAPACK's dgbtrf spills the same triangle into its WORK31 array and
+    undoes interchanges to squeeze back into 2kl+ku+1 rows; carrying w-1
+    extra rows is O(w·n) storage and needs no undo dance).  U needs no
+    spill: a pivot row's entries are bounded by column c0 + kl + ku.
+    ``perms`` [nblk, w+kl] holds each block's window-local row permutation
+    (panel[perm] = L U), replayed by gbtrs — the analog of the reference's
+    per-panel pivot lists."""
+    from .getrf import panel_lu
+    kuw = kl + ku                                  # working upper bandwidth
+    klx = kl + w - 1                               # extended L bandwidth
+    nblk = -(-n // w)
+    Wr = w + kl
+    Wc = w + kuw
+    n_pad = nblk * w + kuw
+    dt = gp.dtype
+    gpp = jnp.zeros((klx + kuw + 1, n_pad), dt)
+    gpp = gpp.at[:kl + kuw + 1, :n].set(gp[:, :n])
+    gpp = gpp.at[kuw, n:].set(jnp.ones((), dt))    # pad diag = 1
+
+    def step(gpp, k):
+        k0 = k * w
+        strip = lax.dynamic_slice(gpp, (0, k0), (klx + kuw + 1, Wc))
+        W = _gather_window(strip, klx, kuw, Wr, Wc)
+        lu, perm = panel_lu(W[:, :w])
+        Wp = W[perm]
+        u12 = lax.linalg.triangular_solve(
+            lu[:w, :w], Wp[:w, w:], left_side=True, lower=True,
+            unit_diagonal=True)
+        w22 = Wp[w:, w:] - lu[w:, :w] @ u12
+        Wn = jnp.concatenate(
+            [lu, jnp.concatenate([u12, w22], axis=0)], axis=1)
+        strip = _scatter_window(strip, Wn, klx, kuw)
+        gpp = lax.dynamic_update_slice(gpp, strip, (0, k0))
+        return gpp, perm
+
+    gpp, perms = lax.scan(step, gpp, jnp.arange(nblk))
+    return gpp[:, :n], perms
+
+
+def gbtrs_banded(gp, perms, kl: int, ku: int, n: int, w: int, b):
+    """Solve A X = b from gbtrf's factors (``gp`` [kl+w-1 + kl+ku + 1, n]):
+    replay per-block perms + banded unit-L forward solve, then banded U
+    (bandwidth kl+ku) backward solve."""
+    kuw = kl + ku
+    klx = kl + w - 1
+    nblk = -(-n // w)
+    dt = b.dtype
+    nrhs = b.shape[1]
+    n_pad = nblk * w + kuw
+    gpp = jnp.zeros((klx + kuw + 1, n_pad), gp.dtype).at[:, :n].set(
+        gp[:, :n])
+    gpp = gpp.at[kuw, n:].set(jnp.ones((), gp.dtype))
+    bp = jnp.zeros((n_pad, nrhs), dt).at[:n].set(b)
+    Wr = w + kl
+    Wc = w + kuw
+
+    def fstep(bp, ka):
+        k, perm = ka
+        k0 = k * w
+        strip = lax.dynamic_slice(gpp, (0, k0), (klx + kuw + 1, Wc))
+        W = _gather_window(strip, klx, kuw, Wr, Wc)
+        bw = lax.dynamic_slice(bp, (k0, 0), (Wr, nrhs))
+        bw = bw[perm]
+        y = lax.linalg.triangular_solve(
+            W[:w, :w], bw[:w], left_side=True, lower=True,
+            unit_diagonal=True)
+        rest = bw[w:] - W[w:, :w] @ y
+        bw = bw.at[:w].set(y).at[w:].set(rest)
+        return lax.dynamic_update_slice(bp, bw, (k0, 0)), None
+
+    bp, _ = lax.scan(fstep, bp, (jnp.arange(nblk), perms))
+
+    def bstep(bp, k):
+        k0 = k * w
+        strip = lax.dynamic_slice(gpp, (0, k0), (klx + kuw + 1, Wc))
+        # U window: rows [k0, k0+w), cols [k0, k0+w+kuw)
+        U = _gather_window(strip, klx, kuw, Wr, Wc)[:w]
+        xw = lax.dynamic_slice(bp, (k0, 0), (Wc, nrhs))
+        rhs = xw[:w] - U[:, w:] @ xw[w:]
+        x = lax.linalg.triangular_solve(
+            U[:, :w], rhs, left_side=True, lower=False)
+        return lax.dynamic_update_slice(bp, x, (k0, 0)), None
+
+    bp, _ = lax.scan(bstep, bp, jnp.arange(nblk - 1, -1, -1))
+    return bp[:n]
+
+
+def banded_trsm_upper(up, ku: int, n: int, w: int, b, *,
+                      unit_diag: bool = False):
+    """Solve U X = b with U upper band (packed [ku+1, n], kl = 0)."""
+    nblk = -(-n // w)
+    n_pad = nblk * w + ku
+    dt = b.dtype
+    nrhs = b.shape[1]
+    upp = jnp.zeros((ku + 1, n_pad), up.dtype).at[:, :n].set(up[:, :n])
+    upp = upp.at[ku, n:].set(jnp.ones((), up.dtype))
+    bp = jnp.zeros((n_pad, nrhs), dt).at[:n].set(b)
+    Wc = w + ku
+
+    def bstep(bp, k):
+        k0 = k * w
+        strip = lax.dynamic_slice(upp, (0, k0), (ku + 1, Wc))
+        U = _gather_window(strip, 0, ku, Wc, Wc)[:w]
+        xw = lax.dynamic_slice(bp, (k0, 0), (Wc, nrhs))
+        rhs = xw[:w] - U[:, w:] @ xw[w:]
+        x = lax.linalg.triangular_solve(
+            U[:, :w], rhs, left_side=True, lower=False,
+            unit_diagonal=unit_diag)
+        return lax.dynamic_update_slice(bp, x, (k0, 0)), None
+
+    bp, _ = lax.scan(bstep, bp, jnp.arange(nblk - 1, -1, -1))
+    return bp[:n]
+
+
+# ------------------------------------------------------------- gbmm
+
+def gbmm_banded(gp, kl: int, ku: int, m: int, n: int, b, alpha, beta, c):
+    """C = alpha A B + beta C with A an m x n band in general packed
+    storage, B [n, nrhs], C [m, nrhs] (ref: src/gbmm.cc).  One fori_loop
+    over the kl+ku+1 stored diagonals; each step is a fused
+    multiply-accumulate over the full RHS block — bandwidth-bound by
+    nature, no MXU contraction to be had."""
+    nrhs = b.shape[1]
+    dt = jnp.result_type(gp.dtype, b.dtype)
+    # accumulator must hold every diagonal's n-row contribution window
+    # ([o, o+n) for o up to kl+ku) AND the m output rows at [ku, ku+m)
+    cp = jnp.zeros((max(m, n) + kl + ku, nrhs), dt)
+    j = jnp.arange(n)
+
+    def body(o, cp):
+        # diagonal o holds A[i, j] with i = j + o - ku
+        i = j + o - ku
+        d = jnp.where((i >= 0) & (i < m), gp[o], jnp.zeros_like(gp[o]))
+        contrib = d[:, None] * b                   # [n, nrhs]
+        seg = lax.dynamic_slice(cp, (o, 0), (n, nrhs))
+        return lax.dynamic_update_slice(cp, seg + contrib, (o, 0))
+
+    cp = lax.fori_loop(0, kl + ku + 1, body, cp)
+    out = cp[ku:ku + m]
+    return alpha * out + (beta * c if c is not None else 0)

@@ -1,0 +1,228 @@
+"""Band solver/multiply tests vs scipy banded references
+(analog of ref test/test_gbsv.cc, test_pbsv.cc, test_tbsm.cc,
+test_gbmm.cc, test_hbmm.cc)."""
+
+import numpy as np
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+
+
+def band_mask(n, kl, ku):
+    i = np.arange(n)[:, None]
+    j = np.arange(n)[None, :]
+    return (j - i <= ku) & (i - j <= kl)
+
+
+def make_band(rng, n, kl, ku, dtype=np.float64):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    return np.where(band_mask(n, kl, ku), a, 0)
+
+
+def make_spd_band(rng, n, kd, dtype=np.float64):
+    a = make_band(rng, n, kd, kd, dtype)
+    a = (a + a.conj().T) / 2
+    return a + n * np.eye(n)
+
+
+@pytest.mark.parametrize("n,kd,nb", [(16, 3, 4), (25, 5, 8), (10, 0, 4),
+                                     (23, 9, 4)])
+def test_pbsv(rng, n, kd, nb):
+    a = make_spd_band(rng, n, kd)
+    b = rng.standard_normal((n, 3))
+    A = st.HermitianBandMatrix.from_numpy(a, kd, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    F, X = st.pbsv(A, B)
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-10)
+
+
+def test_pbsv_complex(rng):
+    n, kd, nb = 18, 4, 5
+    a = make_spd_band(rng, n, kd, np.complex128)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    F, X = st.pbsv(st.HermitianBandMatrix.from_numpy(a, kd, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-10)
+
+
+def test_pbsv_vs_scipy(rng):
+    n, kd, nb = 20, 3, 8
+    a = make_spd_band(rng, n, kd)
+    b = rng.standard_normal((n, 2))
+    # scipy solveh_banded wants upper packed
+    ab = np.zeros((kd + 1, n))
+    for o in range(kd + 1):
+        ab[kd - o, o:] = np.diagonal(a, o)
+    xs = sla.solveh_banded(ab, b)
+    _, X = st.pbsv(st.HermitianBandMatrix.from_numpy(a, kd, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(X.to_numpy(), xs, atol=1e-10)
+
+
+def test_pbtrf_not_pd(rng):
+    n, kd, nb = 12, 2, 4
+    a = make_spd_band(rng, n, kd) - 3 * n * np.eye(n)   # indefinite
+    with pytest.raises(st.SlateNotPositiveDefiniteError):
+        st.pbtrf(st.HermitianBandMatrix.from_numpy(a, kd, nb))
+
+
+@pytest.mark.parametrize("n,kl,ku,nb", [(16, 2, 3, 4), (25, 5, 1, 8),
+                                        (20, 0, 4, 4), (23, 7, 7, 4),
+                                        (10, 3, 0, 4)])
+def test_gbsv(rng, n, kl, ku, nb):
+    a = make_band(rng, n, kl, ku) + np.diag(np.sign(
+        rng.standard_normal(n)) * 2)
+    b = rng.standard_normal((n, 3))
+    A = st.BandMatrix.from_numpy(a, kl, ku, nb)
+    F, X = st.gbsv(A, st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_gbsv_vs_scipy(rng):
+    n, kl, ku, nb = 30, 4, 2, 8
+    a = make_band(rng, n, kl, ku)
+    a += np.diag(np.sign(np.diagonal(a)) + np.diagonal(a))
+    b = rng.standard_normal((n, 2))
+    ab = np.zeros((kl + ku + 1, n))
+    for o in range(-kl, ku + 1):
+        if o >= 0:
+            ab[ku - o, o:] = np.diagonal(a, o)
+        else:
+            ab[ku - o, :n + o] = np.diagonal(a, o)
+    xs = sla.solve_banded((kl, ku), ab, b)
+    _, X = st.gbsv(st.BandMatrix.from_numpy(a, kl, ku, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(X.to_numpy(), xs, atol=1e-9)
+
+
+def test_gbsv_complex(rng):
+    n, kl, ku, nb = 15, 3, 2, 4
+    a = make_band(rng, n, kl, ku, np.complex128)
+    a += 2 * np.eye(n)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    _, X = st.gbsv(st.BandMatrix.from_numpy(a, kl, ku, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_gbsv_needs_pivoting(rng):
+    # leading diagonal zero: partial pivoting must kick in
+    n, kl, ku, nb = 12, 2, 2, 4
+    a = make_band(rng, n, kl, ku)
+    a[0, 0] = 0.0
+    b = rng.standard_normal((n, 1))
+    _, X = st.gbsv(st.BandMatrix.from_numpy(a, kl, ku, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-8)
+
+
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+@pytest.mark.parametrize("op", ["n", "t", "c"])
+def test_tbsm(rng, uplo, op):
+    n, kd, nb = 18, 3, 4
+    a = make_band(rng, n, kd if uplo is st.Uplo.Lower else 0,
+                  0 if uplo is st.Uplo.Lower else kd, np.complex128)
+    a += np.diag(2 + np.abs(np.diagonal(a)))
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    A = st.TriangularBandMatrix.from_numpy(a, kd, nb, uplo)
+    if op == "t":
+        A = A.transpose()
+        ae = a.T
+    elif op == "c":
+        A = A.conj_transpose()
+        ae = a.conj().T
+    else:
+        ae = a
+    X = st.tbsm("l", 2.0, A, st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(ae @ X.to_numpy(), 2.0 * b, atol=1e-9)
+
+
+def test_tbsm_right(rng):
+    n, kd, nb = 12, 2, 4
+    a = np.tril(make_band(rng, n, kd, 0)) + 3 * np.eye(n)
+    b = rng.standard_normal((4, n))
+    A = st.TriangularBandMatrix.from_numpy(a, kd, nb, st.Uplo.Lower)
+    X = st.tbsm("r", 1.0, A, st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(X.to_numpy() @ a, b, atol=1e-9)
+
+
+def test_gbsv_op(rng):
+    # gbsv on a transposed view must solve A^T X = B
+    n, kl, ku, nb = 15, 3, 2, 4
+    a = make_band(rng, n, kl, ku, np.complex128) + 3 * np.eye(n)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    A = st.BandMatrix.from_numpy(a, kl, ku, nb)
+    _, Xt = st.gbsv(A.transpose(), st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a.T @ Xt.to_numpy(), b, atol=1e-9)
+    _, Xh = st.gbsv(A.conj_transpose(), st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a.conj().T @ Xh.to_numpy(), b, atol=1e-9)
+
+
+def test_pbsv_op_complex(rng):
+    # A^T = conj(A) for Hermitian: the transposed view must not alias A
+    n, kd, nb = 14, 3, 4
+    a = make_spd_band(rng, n, kd, np.complex128)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    A = st.HermitianBandMatrix.from_numpy(a, kd, nb)
+    _, X = st.pbsv(A.transpose(), st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a.T @ X.to_numpy(), b, atol=1e-10)
+
+
+def test_pbtrf_jittable(rng):
+    import jax
+    n, kd, nb = 12, 2, 4
+    a = make_spd_band(rng, n, kd)
+
+    def f(ad):
+        A = st.HermitianBandMatrix.from_numpy(ad, kd, nb)
+        return st.pbtrf(A).L_band
+
+    lb = jax.jit(f)(a)
+    assert np.isfinite(np.asarray(lb)).all()
+
+
+def test_gbmm_rectangular(rng):
+    m, n, kl, ku, nb = 6, 8, 2, 1, 4
+    i = np.arange(m)[:, None]
+    j = np.arange(n)[None, :]
+    a = np.where((j - i <= ku) & (i - j <= kl),
+                 rng.standard_normal((m, n)), 0)
+    b = rng.standard_normal((n, 3))
+    A = st.BandMatrix.from_numpy(a, kl, ku, nb)
+    out = st.gbmm(1.0, A, b)
+    np.testing.assert_allclose(np.asarray(out), a @ b, atol=1e-12)
+    # tall case
+    at = np.where((i.T - j.T <= 2) & (j.T - i.T <= 1),
+                  rng.standard_normal((n, m)), 0)
+    out2 = st.gbmm(1.0, st.BandMatrix.from_numpy(at, 2, 1, nb),
+                   rng.standard_normal((m, 2)))
+    assert out2.shape == (n, 2)
+
+
+def test_gbmm(rng):
+    n, kl, ku, nb = 20, 3, 2, 4
+    a = make_band(rng, n, kl, ku)
+    b = rng.standard_normal((n, 5))
+    c = rng.standard_normal((n, 5))
+    A = st.BandMatrix.from_numpy(a, kl, ku, nb)
+    out = st.gbmm(1.5, A, st.Matrix.from_numpy(b, nb, nb), 0.5,
+                  st.Matrix.from_numpy(c, nb, nb))
+    np.testing.assert_allclose(out.to_numpy(), 1.5 * a @ b + 0.5 * c,
+                               atol=1e-11)
+    # transposed band
+    out_t = st.gbmm(1.0, A.transpose(), st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(out_t.to_numpy(), a.T @ b, atol=1e-11)
+
+
+def test_hbmm(rng):
+    n, kd, nb = 16, 3, 4
+    a = make_spd_band(rng, n, kd, np.complex128)
+    b = rng.standard_normal((n, 4)) + 1j * rng.standard_normal((n, 4))
+    A = st.HermitianBandMatrix.from_numpy(a, kd, nb)
+    out = st.hbmm("l", 1.0, A, st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(out.to_numpy(), a @ b, atol=1e-10)
+    outr = st.hbmm("r", 1.0, A, st.Matrix.from_numpy(b.conj().T, nb, nb))
+    np.testing.assert_allclose(outr.to_numpy(), b.conj().T @ a, atol=1e-10)

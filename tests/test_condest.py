@@ -1,0 +1,66 @@
+"""Condition-estimation tests vs exact numpy 1-norm condition numbers
+(analog of ref test/test_gecondest.cc, test_trcondest.cc)."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+@pytest.mark.parametrize("n,nb", [(16, 4), (30, 8)])
+def test_gecondest(rng, n, nb):
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    anorm = np.abs(a).sum(axis=0).max()
+    F = st.getrf(A)
+    rcond = float(st.gecondest(F, anorm))
+    exact = 1.0 / (anorm * np.abs(np.linalg.inv(a)).sum(axis=0).max())
+    # Higham estimator: within a small factor of (and almost always equal
+    # to) the exact value, never an overestimate of rcond by much
+    assert exact / 3 <= rcond <= exact * 3
+    assert 0 < rcond < 1
+
+
+def test_gecondest_illconditioned(rng):
+    n, nb = 24, 8
+    u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -10, n)
+    a = (u * s) @ v.T
+    F = st.getrf(st.Matrix.from_numpy(a, nb, nb))
+    anorm = np.abs(a).sum(axis=0).max()
+    rcond = float(st.gecondest(F, anorm))
+    exact = 1.0 / (anorm * np.abs(np.linalg.inv(a)).sum(axis=0).max())
+    assert rcond < 1e-8                      # detects the ill-conditioning
+    assert exact / 10 <= rcond <= exact * 10
+
+
+def test_gecondest_inf(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    F = st.getrf(st.Matrix.from_numpy(a, nb, nb))
+    anorm = np.abs(a).sum(axis=1).max()
+    rcond = float(st.gecondest(F, anorm, norm=st.Norm.Inf))
+    exact = 1.0 / (anorm * np.abs(np.linalg.inv(a)).sum(axis=1).max())
+    assert exact / 3 <= rcond <= exact * 3
+
+
+def test_trcondest(rng):
+    n, nb = 20, 4
+    r = np.triu(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    R = st.TriangularMatrix.from_numpy(r, nb, st.Uplo.Upper)
+    rcond = float(st.trcondest(R))
+    rnorm = np.abs(r).sum(axis=0).max()
+    exact = 1.0 / (rnorm * np.abs(np.linalg.inv(r)).sum(axis=0).max())
+    assert exact / 3 <= rcond <= exact * 3
+
+
+def test_trcondest_complex(rng):
+    n, nb = 14, 4
+    r = np.triu(rng.standard_normal((n, n))
+                + 1j * rng.standard_normal((n, n))) + 4 * np.eye(n)
+    R = st.TriangularMatrix.from_numpy(r, nb, st.Uplo.Upper)
+    rcond = float(st.trcondest(R))
+    rnorm = np.abs(r).sum(axis=0).max()
+    exact = 1.0 / (rnorm * np.abs(np.linalg.inv(r)).sum(axis=0).max())
+    assert exact / 3 <= rcond <= exact * 3

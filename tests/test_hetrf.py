@@ -1,0 +1,82 @@
+"""Aasen symmetric-indefinite tests (analog of ref test/test_hesv.cc):
+factorization residual P A P^H = L T L^H and solve residual vs numpy."""
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def herm_indef(rng, n, dtype=np.float64):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    a = (a + a.conj().T) / 2
+    # shift to make it clearly indefinite
+    w = np.linalg.eigvalsh(a)
+    a -= np.mean(w) * np.eye(n)
+    return a
+
+
+def tridiag(d, e):
+    n = len(d)
+    T = np.diag(d.astype(complex if np.iscomplexobj(e) else float))
+    if n > 1:
+        T = T + np.diag(e, -1) + np.diag(np.conj(e), 1)
+    return T
+
+
+@pytest.mark.parametrize("n,nb", [(16, 4), (23, 5), (8, 8), (1, 4), (2, 4)])
+def test_hetrf_residual(rng, n, nb):
+    a = herm_indef(rng, n)
+    A = st.SymmetricMatrix.from_numpy(a, nb)
+    F = st.hetrf(A)
+    L = np.asarray(F.L)
+    T = tridiag(np.asarray(F.d), np.asarray(F.e))
+    piv = np.asarray(F.piv)
+    ap = a[piv][:, piv]
+    np.testing.assert_allclose(L @ T @ L.conj().T, ap, atol=1e-10)
+    # L unit lower, first column e_0
+    np.testing.assert_allclose(np.triu(L, 1), 0, atol=0)
+    np.testing.assert_allclose(np.diagonal(L), 1, atol=1e-14)
+    np.testing.assert_allclose(L[1:, 0], 0, atol=0)
+
+
+def test_hetrf_complex(rng):
+    n, nb = 14, 4
+    a = herm_indef(rng, n, np.complex128)
+    F = st.hetrf(st.HermitianMatrix.from_numpy(a, nb))
+    L = np.asarray(F.L)
+    T = tridiag(np.asarray(F.d), np.asarray(F.e))
+    piv = np.asarray(F.piv)
+    np.testing.assert_allclose(L @ T @ L.conj().T, a[piv][:, piv],
+                               atol=1e-10)
+
+
+@pytest.mark.parametrize("n,nb,nrhs", [(16, 4, 3), (25, 8, 1)])
+def test_hesv(rng, n, nb, nrhs):
+    a = herm_indef(rng, n)
+    b = rng.standard_normal((n, nrhs))
+    F, X = st.hesv(st.SymmetricMatrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_hesv_complex(rng):
+    n, nb = 12, 4
+    a = herm_indef(rng, n, np.complex128)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
+    F, X = st.hesv(st.HermitianMatrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_hesv_singularish(rng):
+    # pivoting must handle a zero leading principal minor
+    n, nb = 8, 4
+    a = herm_indef(rng, n)
+    a[0, 0] = 0.0
+    b = rng.standard_normal((n, 1))
+    F, X = st.hesv(st.SymmetricMatrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-8)
