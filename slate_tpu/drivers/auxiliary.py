@@ -170,10 +170,19 @@ def redistribute(A: BaseMatrix, mb: int | None = None, nb: int | None = None,
                  grid: Grid | None = None) -> Matrix:
     """General re-distribution between any two layouts/grids
     (ref: src/redistribute.cc:17-154 tile-by-tile send/recv).  On TPU the
-    all-to-all is one resharding, emitted by XLA from the layout change."""
+    all-to-all is one resharding, emitted by XLA from the layout change.
+
+    Same-tile-size grid changes keep tile blocks intact (a pure cyclic
+    re-permutation + device_put to the new mesh sharding); only tile-size
+    changes go through element-level re-tiling."""
+    from ..types import Op
     mb = mb or A.mb
     nb = nb or A.nb
     grid = grid or A.grid
+    if (type(A) is Matrix and A.op is Op.NoTrans and A.is_root_view()
+            and mb == A.storage.mb and nb == A.storage.nb):
+        tiles = A.storage.canonical()
+        return Matrix(TileStorage.from_canonical(tiles, A.m, A.n, grid))
     dense = A.to_dense()
     return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
 

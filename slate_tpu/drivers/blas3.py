@@ -106,7 +106,7 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     mesh: parallel.dist_trsm substitution pipeline with panel broadcasts.
     """
     from ..core.matrix import BaseTrapezoidMatrix
-    from ..parallel.dist_trsm import dist_trsm_left
+    from ..parallel.dist_trsm import dist_trsm_left, dist_trsm_right
     sd = _side(side)
     slate_error(isinstance(A, BaseTrapezoidMatrix), "trsm: A not triangular")
     slate_error(A._m_store() == A._n_store(), "trsm: A not square")
@@ -118,24 +118,23 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     unit = A.diag is Diag.Unit
 
     if target is Target.mesh and B.grid.mesh is not None:
-        if sd is Side.Right:
-            if A.op is Op.ConjTrans:
-                # X A^H = alpha B  <=>  A X^H = conj(alpha) B^H
-                Xh = trsm(Side.Left, jnp.conj(jnp.asarray(alpha)),
-                          A.conj_transpose(), _conj_transposed_root(B), opts)
-                return _conj_transposed_root(Xh)
-            # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
-            Xt = trsm(Side.Left, alpha, A.transpose(),
-                      _transposed_root(B), opts)
-            return _transposed_root(Xt)
         lower = A.uplo is Uplo.Lower       # storage triangle
         nb = A.storage.nb
         An = _root_storage_triangular(A, grid=B.grid)
-        Bn = as_root_general(B, nb, None, grid=B.grid)
-        data = dist_trsm_left(An.storage.data, Bn.storage.data,
-                              jnp.asarray(alpha, Bn.dtype),
-                              Nt=An.storage.Nt, grid=B.grid, lower=lower,
-                              op_a=A.op, unit_diag=unit, n=An.storage.n)
+        if sd is Side.Right:
+            # direct column-substitution kernel: no dense transpose
+            Bn = as_root_general(B, None, nb, grid=B.grid)
+            data = dist_trsm_right(An.storage.data, Bn.storage.data,
+                                   jnp.asarray(alpha, Bn.dtype),
+                                   Nt=An.storage.Nt, grid=B.grid,
+                                   lower=lower, op_a=A.op, unit_diag=unit,
+                                   n=An.storage.n)
+        else:
+            Bn = as_root_general(B, nb, None, grid=B.grid)
+            data = dist_trsm_left(An.storage.data, Bn.storage.data,
+                                  jnp.asarray(alpha, Bn.dtype),
+                                  Nt=An.storage.Nt, grid=B.grid, lower=lower,
+                                  op_a=A.op, unit_diag=unit, n=An.storage.n)
         st = Bn.storage
         return Matrix(TileStorage(data, st.m, st.n, st.mb, st.nb, st.grid))
 
@@ -148,17 +147,6 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
         transpose_a=(A.op is not Op.NoTrans),
         conjugate_a=(A.op is Op.ConjTrans), unit_diagonal=unit)
     return _dense_to_like(B, xd)
-
-
-def _transposed_root(B) -> Matrix:
-    """Materialised transpose as a root general matrix on B's grid."""
-    d = B.to_dense().T
-    return Matrix(TileStorage.from_dense(d, B.nb, B.mb, B.grid))
-
-
-def _conj_transposed_root(B) -> Matrix:
-    d = jnp.conj(B.to_dense()).T
-    return Matrix(TileStorage.from_dense(d, B.nb, B.mb, B.grid))
 
 
 def _root_storage_triangular(A, grid=None):

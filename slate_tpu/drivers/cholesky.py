@@ -54,9 +54,16 @@ def potrf(A, opts: Options | None = None) -> TriangularMatrix:
     nb = A.nb
 
     if target is Target.mesh and A.grid.mesh is not None:
-        # factor the LOWER representation; Upper comes back as L^H view
-        full = A.to_dense()
-        st_l = TileStorage.from_dense(full, nb, nb, A.grid)
+        # factor the LOWER representation; Upper comes back as L^H view.
+        # dist_potrf reads ONLY the lower triangle (diag tiles are
+        # Hermitian-completed in-kernel), so a lower-stored root view goes
+        # in zero-copy — no whole-matrix densification on the mesh path.
+        if (A.uplo is Uplo.Lower and A.op is Op.NoTrans
+                and A.is_root_view() and A.storage.mb == nb):
+            st_l = A.storage
+        else:
+            full = A.to_dense()
+            st_l = TileStorage.from_dense(full, nb, nb, A.grid)
         out = dist_potrf(st_l.data, st_l.Nt, A.grid, n=st_l.n)
         st_out = TileStorage(out, st_l.m, st_l.n, nb, nb, A.grid)
         L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)

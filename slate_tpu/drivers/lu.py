@@ -28,7 +28,7 @@ from ..ops.elementwise import entry_mask
 from ..options import (MethodLU, Option, Options, Target, get_option,
                        resolve_target, select_lu_method)
 from ..parallel.dist_lu import dist_getrf
-from ..types import Diag, Uplo
+from ..types import Diag, Op, Uplo
 from .blas3 import as_root_general, trsm
 
 
@@ -121,10 +121,22 @@ def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
 
 
 def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
-    """Solve with LU factors: X = U^-1 L^-1 B[perm] (ref: src/getrs.cc)."""
+    """Solve with LU factors: X = U^-1 L^-1 B[perm] (ref: src/getrs.cc).
+
+    On the mesh the pivot application is sharded (dist_permute_rows —
+    each rank holds a 1/q column strip, never a replicated dense B)."""
+    from ..parallel.dist_lu import dist_permute_rows
     slate_error(F.LU.m == B.m, "getrs: dims")
-    bperm = B.to_dense()[F.perm]
-    Bp = Matrix(TileStorage.from_dense(bperm, B.mb, B.nb, B.grid))
+    target = resolve_target(opts, B)
+    if (target is Target.mesh and B.grid.mesh is not None
+            and type(B) is Matrix and B.op is Op.NoTrans
+            and B.is_root_view()):
+        st = B.storage
+        bp_data = dist_permute_rows(st.data, F.perm, B.grid)
+        Bp = Matrix(TileStorage(bp_data, st.m, st.n, st.mb, st.nb, st.grid))
+    else:
+        bperm = B.to_dense()[F.perm]
+        Bp = Matrix(TileStorage.from_dense(bperm, B.mb, B.nb, B.grid))
     Y = trsm("l", 1.0, F.lower(), Bp, opts)
     return trsm("l", 1.0, F.upper(), Y, opts)
 

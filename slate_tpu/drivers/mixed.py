@@ -12,8 +12,16 @@ natively fast in f32/bf16 while f64 is emulated, so "factor fast + refine
 accurate" is how f64-grade solutions are produced at speed
 (types.lower_precision: f64->f32, c128->c64, f32->bf16).
 
+TPU-first shape: both refinement loops are lax.while_loop bodies whose
+residuals ride the DISTRIBUTED gemm (never a replicated dense A), solves
+ride the distributed factor paths, and the whole solver jits into one XLA
+program.  GMRES-IR solves the whole RHS block at once — one Krylov basis
+per column, advanced in lockstep (columnwise Arnoldi, the blocked analog of
+gesv_mixed_gmres.cc's per-column spaces).  Only the optional full-precision
+fallback syncs one boolean to the host, and only when called eagerly.
+
 Convergence test mirrors the reference (gesv_mixed.cc): the residual is
-converged when ||r||_inf <= ||x||_inf * ||A||_inf * eps * sqrt(n) * stew.
+converged when ||r||_max <= ||x||_max * ||A||_inf * eps * sqrt(n).
 """
 
 from __future__ import annotations
@@ -21,15 +29,16 @@ from __future__ import annotations
 import math
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
 from ..options import Option, Options, get_option
 from ..types import Norm, eps, lower_precision
 from . import auxiliary as aux
+from .blas3 import gemm
 from .cholesky import potrf, potrs
 from .lu import getrf, getrs
 
@@ -40,165 +49,212 @@ class MixedResult(NamedTuple):
     converged: bool
 
 
-def _refine(A: Matrix, B, solve_lo, opts: Options | None, hermitian=False):
-    """Shared IR loop (ref: gesv_mixed.cc iterative refinement body)."""
+def _cast_matrix(M, dt) -> Matrix:
+    return Matrix(M.storage.astype(dt), M.io, M.jo, M._mt, M._nt, M.op)
+
+
+def _residual(A: Matrix, X: Matrix, B: Matrix, opts) -> Matrix:
+    """R = B - A X via the (mesh-aware) gemm driver — A is never
+    densified (ref: gesv_mixed.cc residual gemm)."""
+    return gemm(-1.0, A, X, 1.0, _cast_matrix(B, X.dtype), opts)
+
+
+def _refine(A: Matrix, B: Matrix, solve_lo, opts: Options | None):
+    """Shared IR loop (ref: gesv_mixed.cc body) as ONE lax.while_loop."""
     itermax = get_option(opts, Option.MaxIterations)
-    use_fallback = get_option(opts, Option.UseFallbackSolver)
-    ad = A.to_dense()
-    bd = B.to_dense()
-    n = ad.shape[0]
-    anorm = jnp.max(jnp.sum(jnp.abs(ad), axis=1))        # inf-norm
-    tol = eps(ad.dtype) * math.sqrt(n)
+    n = A.m
+    anorm = aux.norm(Norm.Inf, A)
+    tol = eps(A.dtype) * math.sqrt(n)
 
-    x = solve_lo(bd)
-    it = 0
-    converged = False
-    for it in range(1, itermax + 1):
-        r = bd - ad @ x
-        xnorm = jnp.max(jnp.abs(x))
-        rnorm = jnp.max(jnp.abs(r))
-        if bool(rnorm <= xnorm * anorm * tol):
-            converged = True
-            break
-        x = x + solve_lo(r)
-    return x, it, converged
+    x0 = solve_lo(B)
+    r0 = _residual(A, x0, B, opts)
+
+    def is_conv(x, r):
+        return aux.norm(Norm.Max, r) <= aux.norm(Norm.Max, x) * anorm * tol
+
+    def cond(state):
+        _, _, it, conv = state
+        return jnp.logical_not(conv) & (it < itermax)
+
+    def body(state):
+        x, r, it, _ = state
+        x = aux.add(1.0, solve_lo(r), 1.0, x)
+        r = _residual(A, x, B, opts)
+        return x, r, it + 1, is_conv(x, r)
+
+    x, r, it, conv = lax.while_loop(
+        cond, body, (x0, r0, jnp.asarray(0), is_conv(x0, r0)))
+    return x, it, conv
 
 
-def _wrap(B, xd) -> Matrix:
-    return Matrix(TileStorage.from_dense(xd, B.mb, B.nb, B.grid))
+def _maybe_fallback(ok, x, fallback):
+    """Full-precision fallback (ref: gesv_mixed_gmres.cc:58-77).  Traced
+    calls skip it (the converged flag is still reported)."""
+    if isinstance(ok, jax.core.Tracer):
+        return x, ok
+    if not bool(ok):
+        return fallback(), True
+    return x, True
 
 
 def gesv_mixed(A: Matrix, B, opts: Options | None = None) -> MixedResult:
     """LU in low precision + IR to working precision
     (ref: src/gesv_mixed.cc)."""
     lo = lower_precision(A.dtype)
-    Alo = Matrix(A.storage.astype(lo), A.io, A.jo, A._mt, A._nt, A.op)
+    Alo = _cast_matrix(A, lo)
     F = getrf(Alo, opts)
 
-    def solve_lo(rhs):
-        R = _wrap(B, rhs.astype(lo))
-        return getrs(F, R, opts).to_dense().astype(A.dtype)
+    def solve_lo(R):
+        return _cast_matrix(getrs(F, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _refine(A, B, solve_lo, opts)
-    if not ok and get_option(opts, Option.UseFallbackSolver):
-        # ref: gesv_mixed_gmres.cc:58-77 — full-precision fallback
-        Ff = getrf(A, opts)
-        x = getrs(Ff, B, opts).to_dense()
-        ok = True
-    return MixedResult(_wrap(B, x), it, ok)
+    if get_option(opts, Option.UseFallbackSolver):
+        x, ok = _maybe_fallback(ok, x, lambda: getrs(getrf(A, opts), B,
+                                                     opts))
+    return MixedResult(x, it, ok)
 
 
 def posv_mixed(A: HermitianMatrix, B, opts: Options | None = None
                ) -> MixedResult:
     """Cholesky in low precision + IR (ref: src/posv_mixed.cc)."""
     lo = lower_precision(A.dtype)
-    Alo = HermitianMatrix._from_view(
-        Matrix(A.storage.astype(lo), A.io, A.jo, A._mt, A._nt, A.op),
-        A.uplo)
+    Alo = HermitianMatrix._from_view(_cast_matrix(A, lo), A.uplo)
     L = potrf(Alo, opts)
 
-    def solve_lo(rhs):
-        R = _wrap(B, rhs.astype(lo))
-        return potrs(L, R, opts).to_dense().astype(A.dtype)
+    def solve_lo(R):
+        return _cast_matrix(potrs(L, _cast_matrix(R, lo), opts), A.dtype)
 
-    x, it, ok = _refine(A, B, solve_lo, opts, hermitian=True)
-    if not ok and get_option(opts, Option.UseFallbackSolver):
-        Lf = potrf(A, opts)
-        x = potrs(Lf, B, opts).to_dense()
-        ok = True
-    return MixedResult(_wrap(B, x), it, ok)
+    x, it, ok = _refine(A, B, solve_lo, opts)
+    if get_option(opts, Option.UseFallbackSolver):
+        x, ok = _maybe_fallback(ok, x, lambda: potrs(potrf(A, opts), B,
+                                                     opts))
+    return MixedResult(x, it, ok)
 
 
-def _gmres_ir(A: Matrix, B, solve_lo, opts: Options | None):
-    """GMRES-IR: restarted GMRES in working precision, low-precision factor
-    as right preconditioner (ref: src/gesv_mixed_gmres.cc:24-117; restart
-    depth 10, itermax 30)."""
+# ---------------------------------------------------------------- GMRES-IR
+
+def _gmres_ir(A: Matrix, B: Matrix, solve_lo, opts: Options | None,
+              restart: int = 10):
+    """Blocked right-preconditioned restarted GMRES in working precision
+    (ref: src/gesv_mixed_gmres.cc:24-117; restart depth 10, itermax 30).
+
+    All nrhs columns advance one shared Arnoldi loop in lockstep — each
+    column keeps its own Krylov basis and Hessenberg, stored batched.  The
+    basis vectors are skinny [n, nrhs] blocks (replicating them is cheap);
+    every matvec is the distributed gemm and every preconditioner
+    application is the distributed low-precision solve."""
     itermax = get_option(opts, Option.MaxIterations)
-    restart = 10
-    ad = A.to_dense()
-    bd = B.to_dense()
-    n = ad.shape[0]
-    anorm = jnp.max(jnp.sum(jnp.abs(ad), axis=1))
-    tol = eps(ad.dtype) * math.sqrt(n)
-
+    n = A.m
+    dt = A.dtype
+    anorm = aux.norm(Norm.Inf, A)
+    tol = eps(dt) * math.sqrt(n)
+    bd = B.to_dense()                         # skinny [n, nrhs]
     nrhs = bd.shape[1]
-    x = jnp.zeros_like(bd)
-    total_it = 0
-    converged = False
-    # solve each RHS column with GMRES (reference solves the block with one
-    # Krylov space per column internally too)
-    cols = []
-    for j in range(nrhs):
-        b = bd[:, j]
-        xj = jnp.zeros_like(b)
-        done = False
-        for _ in range(itermax // restart + 1):
-            r = b - ad @ xj
-            beta = jnp.linalg.norm(r)
-            if bool(beta <= jnp.max(jnp.abs(xj)) * anorm * tol + 1e-300):
-                done = True
-                break
-            V = [r / beta]
-            H = jnp.zeros((restart + 1, restart), ad.dtype)
-            m_used = restart
-            for i in range(restart):
-                z = solve_lo(V[i][:, None])[:, 0]        # precondition
-                w = ad @ z
-                for t in range(i + 1):
-                    h = jnp.vdot(V[t], w)
-                    H = H.at[t, i].set(h)
-                    w = w - h * V[t]
-                hn = jnp.linalg.norm(w)
-                H = H.at[i + 1, i].set(hn)
-                V.append(w / (hn + 1e-300))
-                total_it += 1
-            # solve least squares min ||beta e1 - H y||
-            e1 = jnp.zeros((restart + 1,), ad.dtype).at[0].set(beta)
-            y, *_ = jnp.linalg.lstsq(H, e1)
-            Z = jnp.stack([solve_lo(v[:, None])[:, 0]
-                           for v in V[:restart]], axis=1)
-            xj = xj + Z @ y
-        cols.append(xj)
-        converged = done
-    x = jnp.stack(cols, axis=1)
-    return x, total_it, converged
+
+    def mat_vec(z):
+        """A @ z for a skinny block z [n, nrhs] (distributed gemm)."""
+        Z = Matrix(TileStorage.from_dense(z, A.nb, B.nb, A.grid))
+        return gemm(1.0, A, Z, 0.0, None, opts).to_dense()
+
+    def prec(z):
+        Z = Matrix(TileStorage.from_dense(z, A.nb, B.nb, A.grid))
+        return solve_lo(Z).to_dense()
+
+    def arnoldi(x):
+        """One restart cycle for every column at once."""
+        r = bd - mat_vec(x)
+        beta = jnp.linalg.norm(r, axis=0)                  # [nrhs]
+        conv = (jnp.max(jnp.abs(r), axis=0) <=
+                jnp.max(jnp.abs(x), axis=0) * anorm * tol + 1e-300)
+        safe_beta = jnp.where(beta > 0, beta, jnp.ones_like(beta))
+        V0 = jnp.zeros((restart + 1, n, nrhs), dt)
+        V0 = V0.at[0].set(r / safe_beta)
+        H0 = jnp.zeros((restart + 1, restart, nrhs), dt)
+
+        def arn_step(i, carry):
+            V, H = carry
+            vi = lax.dynamic_index_in_dim(V, i, axis=0, keepdims=False)
+            w = mat_vec(prec(vi))                          # [n, nrhs]
+            # modified Gram-Schmidt against all stored vectors (rows > i
+            # are zero, so their coefficients vanish identically)
+            def mgs(t, wh):
+                w, H = wh
+                vt = lax.dynamic_index_in_dim(V, t, axis=0, keepdims=False)
+                h = jnp.sum(jnp.conj(vt) * w, axis=0)      # [nrhs]
+                live = t <= i
+                h = jnp.where(live, h, jnp.zeros_like(h))
+                H = H.at[t, i].set(h)
+                return w - vt * h[None, :], H
+
+            w, H = lax.fori_loop(0, restart + 1, mgs, (w, H))
+            hn = jnp.linalg.norm(w, axis=0)
+            H = H.at[i + 1, i].set(hn.astype(dt))
+            V = V.at[i + 1].set(w / (hn[None, :] + 1e-300))
+            return V, H
+
+        V, H = lax.fori_loop(0, restart, arn_step, (V0, H0))
+
+        # per-column least squares: min_y ||beta e1 - H_j y|| via normal
+        # equations on the (restart+1) x restart Hessenberg (tiny, well
+        # scaled after orthonormalization)
+        Hc = jnp.transpose(H, (2, 0, 1))                   # [nrhs, m+1, m]
+        rhs = jnp.zeros((nrhs, restart + 1), dt).at[:, 0].set(
+            beta.astype(dt))
+        G = jnp.einsum("nij,nik->njk", jnp.conj(Hc), Hc)
+        G = G + eps(dt) * jnp.eye(restart, dtype=dt)[None]
+        gb = jnp.einsum("nij,ni->nj", jnp.conj(Hc), rhs)
+        y = jnp.linalg.solve(G, gb[..., None])[..., 0]     # [nrhs, m]
+        # x += M^-1 (V y)   (right preconditioning is linear)
+        vy = jnp.einsum("inr,ir->nr", V[:restart], y.T)
+        dx = prec(vy)
+        x_new = x + dx
+        return jnp.where(conv[None, :], x, x_new), conv
+
+    def cond(state):
+        _, it, conv = state
+        return jnp.logical_not(jnp.all(conv)) & (it < itermax)
+
+    def body(state):
+        x, it, _ = state
+        x, conv = arnoldi(x)
+        return x, it + restart, conv
+
+    x0 = jnp.zeros_like(bd)
+    x, it, conv = lax.while_loop(
+        cond, body, (x0, jnp.asarray(0), jnp.zeros((nrhs,), bool)))
+    X = Matrix(TileStorage.from_dense(x, B.mb, B.nb, B.grid))
+    return X, it, jnp.all(conv)
 
 
 def gesv_mixed_gmres(A: Matrix, B, opts: Options | None = None
                      ) -> MixedResult:
     """ref: src/gesv_mixed_gmres.cc"""
     lo = lower_precision(A.dtype)
-    Alo = Matrix(A.storage.astype(lo), A.io, A.jo, A._mt, A._nt, A.op)
+    Alo = _cast_matrix(A, lo)
     F = getrf(Alo, opts)
 
-    def solve_lo(rhs):
-        R = _wrap(B, rhs.astype(lo))
-        return getrs(F, R, opts).to_dense().astype(A.dtype)
+    def solve_lo(R):
+        return _cast_matrix(getrs(F, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _gmres_ir(A, B, solve_lo, opts)
-    if not ok and get_option(opts, Option.UseFallbackSolver):
-        Ff = getrf(A, opts)
-        x = getrs(Ff, B, opts).to_dense()
-        ok = True
-    return MixedResult(_wrap(B, x), it, ok)
+    if get_option(opts, Option.UseFallbackSolver):
+        x, ok = _maybe_fallback(ok, x, lambda: getrs(getrf(A, opts), B,
+                                                     opts))
+    return MixedResult(x, it, ok)
 
 
 def posv_mixed_gmres(A: HermitianMatrix, B, opts: Options | None = None
                      ) -> MixedResult:
     """ref: src/posv_mixed_gmres.cc"""
     lo = lower_precision(A.dtype)
-    Alo = HermitianMatrix._from_view(
-        Matrix(A.storage.astype(lo), A.io, A.jo, A._mt, A._nt, A.op),
-        A.uplo)
+    Alo = HermitianMatrix._from_view(_cast_matrix(A, lo), A.uplo)
     L = potrf(Alo, opts)
 
-    def solve_lo(rhs):
-        R = _wrap(B, rhs.astype(lo))
-        return potrs(L, R, opts).to_dense().astype(A.dtype)
+    def solve_lo(R):
+        return _cast_matrix(potrs(L, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _gmres_ir(A, B, solve_lo, opts)
-    if not ok and get_option(opts, Option.UseFallbackSolver):
-        Lf = potrf(A, opts)
-        x = potrs(Lf, B, opts).to_dense()
-        ok = True
-    return MixedResult(_wrap(B, x), it, ok)
+    if get_option(opts, Option.UseFallbackSolver):
+        x, ok = _maybe_fallback(ok, x, lambda: potrs(potrf(A, opts), B,
+                                                     opts))
+    return MixedResult(x, it, ok)

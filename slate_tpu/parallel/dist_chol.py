@@ -15,15 +15,15 @@ listBcastMT(A(i,k) -> row i, col i)    | scatter into a global panel buffer
   :232-242                             |   + psum over both mesh axes
 internal::herk trailing update :254    | einsum over the rank's trailing
                                        |   slice (static shrinking sizes)
-lookahead tasks :266-287               | XLA pipelines across unrolled k
+lookahead tasks :266-287               | XLA pipelines across fori_loop steps
 release/tileUpdateAllOrigin :289-302   | SSA buffer lifetimes
 
-The k loop is UNROLLED at trace time: each step has statically-shaped
-shrinking trailing slices (the ScaLAPACK discipline), so no masked-FLOP waste
-grows with Nt; per-rank ragged boundaries are handled by masking at most one
-extra tile row/col.  Block-cyclic distribution keeps every rank busy until
-the final panels — the load-balance property the reference gets from the same
-distribution (MatrixStorage.hh:555-568).
+Compile-time scaling: the k loop is TWO-LEVEL.  The outer level unrolls
+~SUPERBLOCKS superblocks at trace time, each with STATIC trailing-slice
+sizes (the ScaLAPACK shrinking discipline, so masked-FLOP waste is bounded
+by ~1.5·sb/Nt); the inner level is a lax.fori_loop over the superblock's k
+steps with traced indices — so the compiled program size is O(SUPERBLOCKS),
+not O(Nt), and n=50k/nb=256 (Nt≈196) compiles like Nt=16 does.
 
 Only Uplo.Lower is implemented here; the driver maps Upper problems onto it
 (ref: potrf.cc handles Upper by conjugate-transposing views the same way).
@@ -42,92 +42,125 @@ from ..internal.potrf import potrf_tile
 from ..internal.trsm import trsm_tile_batch
 from ..types import Op
 
+SUPERBLOCKS = 16
 
-def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int):
+
+def superblock(Nt: int, target: int = SUPERBLOCKS) -> int:
+    """Inner fori_loop span: ~``target`` compiled bodies regardless of Nt."""
+    return max(1, -(-Nt // target))
+
+
+def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
+                 sb: int):
     """Per-shard body; a_loc [mtl, ntl, nb, nb] block-cyclic local tiles."""
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
     dt = a_loc.dtype
+    idx = jnp.arange(nb)
+    gi_all = r + p * jnp.arange(mtl)              # global tile row per slot
+    zi = jnp.zeros((), jnp.int32)
 
-    for k in range(Nt):
+    def step(k, a_loc):
         rk, ck = k % p, k % q
         kkr, kkc = k // p, k // q
-        # valid extent of diagonal tile k (last tile may be ragged); the pad
-        # diagonal is identity-augmented so the tile factor stays finite
-        # (XLA's potrf NaN-fills the whole tile on a singular input), then
-        # zeroed again before write-back to keep the pad==0 invariant.
-        vk = nb if k < Nt - 1 else n - (Nt - 1) * nb
-        idx = jnp.arange(nb)
+        # valid extent of diagonal tile k (ragged last tile); pad diagonal
+        # identity-augmented so the tile factor stays finite, re-zeroed on
+        # write-back to keep the pad==0 invariant
+        vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
         pad_eye = jnp.diag((idx >= vk).astype(dt))
-        vmask = ((idx[:, None] < vk) & (idx[None, :] < vk))
+        vmask = (idx[:, None] < vk) & (idx[None, :] < vk)
 
         # -- diagonal tile: gather from owner, factor everywhere --
-        dtile = jnp.where((r == rk) & (c == ck),
-                          a_loc[kkr, kkc], jnp.zeros((nb, nb), dt))
+        dtile = lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+            kkc, axis=0, keepdims=False)
+        dtile = jnp.where((r == rk) & (c == ck), dtile,
+                          jnp.zeros((nb, nb), dt))
         dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+        # Hermitian-complete from the stored lower triangle: only the lower
+        # triangle of the input is ever read, so callers may pass storage
+        # whose upper tiles hold junk (XLA's cholesky reads the full tile
+        # on some backends)
+        dlow = jnp.tril(dtile)
+        ddiag = jnp.diagonal(dtile)
+        if jnp.iscomplexobj(dtile):
+            ddiag = jnp.real(ddiag).astype(dt)
+        dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
         lkk_aug = potrf_tile(dtile + pad_eye)
         lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
 
         # -- panel trsm on the owner column's local tiles --
-        pan = a_loc[:, kkc]                       # [mtl, nb, nb]
+        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
         sol = trsm_tile_batch(lkk_aug, pan, left=False, lower=True,
                               op_tri=Op.ConjTrans)
 
-        # write back: row k gets L_kk (at its owner), rows i>k the solve
-        gi_all = r + p * jnp.arange(mtl)          # global row of each slot
         keep = (gi_all[:, None, None] <= k)
         newcol = jnp.where(keep, pan, sol)
         newcol = jnp.where((gi_all == k)[:, None, None], lkk, newcol)
-        a_loc = jnp.where((c == ck),
-                          a_loc.at[:, kkc].set(newcol), a_loc)
+        col_sel = jnp.where(c == ck, newcol, pan)
+        a_loc = lax.dynamic_update_slice(
+            a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
 
-        if k == Nt - 1:
-            break
-
-        # -- broadcast the panel column to every rank (row i + col i owners,
-        #    ref listBcastMT potrf.cc:232-242): scatter to global buffer and
-        #    psum over the mesh --
+        # -- broadcast the panel column to every rank (ref listBcastMT
+        #    potrf.cc:232-242): scatter to global buffer, psum the mesh --
         buf = jnp.zeros((p * mtl, nb, nb), dt)
         contrib = jnp.where((gi_all > k)[:, None, None], sol,
                             jnp.zeros_like(sol))
         buf = buf.at[gi_all].set(contrib)
         buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
         gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)   # [p*mtl, nb, nb]
+        return a_loc, gpan
 
-        # -- trailing update on this rank's static-size slice --
-        S = mtl - max(0, (k + 1) // p)            # max local trailing rows
-        T = ntl - max(0, (k + 1) // q)
+    for k0 in range(0, Nt, sb):
+        k1 = min(k0 + sb, Nt)
+        # static trailing window (max over ranks) for this superblock:
+        # local slots whose global index can be >= k0
+        S = mtl - (k0 // p)
+        T = ntl - (k0 // q)
+
+        def super_step(k, a_loc, S=S, T=T):
+            a_loc, gpan = step(k, a_loc)
+
+            def trailing(a_loc):
+                sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
+                sc = jnp.clip(-(-(k0 - c) // q), 0, ntl - T).astype(jnp.int32)
+                gi = r + p * (sr + jnp.arange(S))
+                gj = c + q * (sc + jnp.arange(T))
+                prow = gpan[gi]                   # [S, nb, nb]
+                pcol = gpan[gj]                   # [T, nb, nb]
+                upd = herk_panel_update(prow, pcol)
+                cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
+                                        (S, T, nb, nb))
+                mask = ((gi > k)[:, None, None, None] &
+                        (gj > k)[None, :, None, None])
+                new = jnp.where(mask, cur - upd, cur)
+                return lax.dynamic_update_slice(a_loc, new,
+                                                (sr, sc, zi, zi))
+
+            return lax.cond(k < Nt - 1, trailing, lambda a: a, a_loc)
+
         if S <= 0 or T <= 0:
+            # no rank has trailing tiles only when k0 >= Nt (cannot happen)
             continue
-        sr = jnp.clip((k + 1 - r + p - 1) // p, 0, mtl - S)
-        sc = jnp.clip((k + 1 - c + q - 1) // q, 0, ntl - T)
-
-        gi = r + p * (sr + jnp.arange(S))         # global rows of the slice
-        gj = c + q * (sc + jnp.arange(T))
-        prow = gpan[gi]                           # [S, nb, nb]
-        pcol = gpan[gj]                           # [T, nb, nb]
-        upd = herk_panel_update(prow, pcol)       # [S, T, nb, nb]
-
-        z = jnp.zeros((), sr.dtype)
-        cur = lax.dynamic_slice(a_loc, (sr, sc, z, z), (S, T, nb, nb))
-        mask = ((gi > k)[:, None, None, None] & (gj > k)[None, :, None, None])
-        new = jnp.where(mask, cur - upd, cur)
-        a_loc = lax.dynamic_update_slice(a_loc, new, (sr, sc, z, z))
+        a_loc = lax.fori_loop(k0, k1, super_step, a_loc)
 
     return a_loc
 
 
-def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None):
+def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
+               sb: int | None = None):
     """Factor the cyclic storage array of a Hermitian (lower) matrix in
     place: lower tiles of the result hold L.  ``n`` is the element dimension
-    (for ragged last tiles); defaults to Nt*nb (exact tiling)."""
+    (for ragged last tiles); defaults to Nt*nb (exact tiling).  ``sb`` is
+    the inner fori_loop span (default: ~SUPERBLOCKS compiled bodies)."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     nb = data.shape[-1]
     n = n if n is not None else Nt * nb
+    sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = jax.shard_map(
-        lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl),
+        lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
         mesh=grid.mesh, in_specs=(spec,), out_specs=spec)
     return fn(data)

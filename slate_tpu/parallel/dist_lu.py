@@ -20,6 +20,13 @@ batched trailing gemm                     | one einsum per rank on its
 pivot-left task (getrf.cc:154-172)        | bundle exchange covers all
                                           |   columns, left included
 
+Compile-time scaling mirrors dist_chol: ~SUPERBLOCKS unrolled superblocks,
+each a lax.fori_loop over its k steps.  The replicated panel buffer is the
+superblock-start size (Nt-k0 tiles); each inner step ROLLS the active rows
+to the top and zeroes the factored tail (zero rows lose every pivot
+contest, so XLA's pivoted LU of the padded panel equals the LU of the
+active panel with identity tail permutation).
+
 The permutation is tracked as a full row-permutation vector ``perm`` with
 ``A[perm] == L @ U`` (identical semantics to composing the reference's
 Pivot lists).  Square matrices only (gesv path); ragged last tiles handled
@@ -35,6 +42,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.getrf import panel_lu, panel_lu_nopiv, panel_lu_tournament
+from .dist_chol import superblock
 
 
 def _gather_panel(a_loc, k, p, q, mtl, r, c):
@@ -42,7 +50,7 @@ def _gather_panel(a_loc, k, p, q, mtl, r, c):
     nb = a_loc.shape[-1]
     kkc = k // q
     ck = k % q
-    pan = a_loc[:, kkc]                          # my rows of column k
+    pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
     gi_all = r + p * jnp.arange(mtl)
     buf = jnp.zeros((p * mtl, nb, nb), a_loc.dtype)
     buf = buf.at[gi_all].set(pan)
@@ -50,7 +58,7 @@ def _gather_panel(a_loc, k, p, q, mtl, r, c):
     return lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
 
 
-def _row_bundle_exchange(a_loc, out_rows, in_rows, k_nb, p, r, nbundle):
+def _row_bundle_exchange(a_loc, out_rows, in_rows, p, r, nbundle):
     """Move rows: new A[out_rows[b], :] = old A[in_rows[b], :] for all local
     columns, with one psum along the p axis (permuteRows analog).
 
@@ -84,104 +92,182 @@ def _row_bundle_exchange(a_loc, out_rows, in_rows, k_nb, p, r, nbundle):
 
 
 def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
-                      ib: int):
+                      ib: int, sb: int):
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
     dt = a_loc.dtype
     m_pad = p * mtl * nb
-    perm_g = jnp.arange(m_pad)
+    # sb*nb slack so the dynamic window slice below never clips
+    perm_g = jnp.arange(m_pad + sb * nb)
+    gi_all = r + p * jnp.arange(mtl)
+    idx = jnp.arange(nb)
+    zi = jnp.zeros((), jnp.int32)
 
-    for k in range(Nt):
-        rk, ck = k % p, k % q
-        kkr, kkc = k // p, k // q
-        W = (Nt - k) * nb                        # panel window rows
-        vk = nb if k < Nt - 1 else n - (Nt - 1) * nb
+    for k0 in range(0, Nt, sb):
+        k1 = min(k0 + sb, Nt)
+        W0 = Nt - k0                             # panel tiles this superblock
+        W = W0 * nb
+        nbundle = min(2 * nb, W)
+        S = mtl - ((k0 + 1) // p)                # static trailing bounds
+        T = ntl - ((k0 + 1) // q)
 
-        # ---- gather + factor the panel (replicated) ----
-        gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
-        panel = gpan[k:Nt].reshape(W, nb)
-        if vk < nb:                              # ragged final tile: augment
-            t = jnp.arange(nb - vk)
-            panel = panel.at[vk + t, vk + t].set(jnp.ones((), dt))
-        if method == "nopiv":
-            lu, perm = panel_lu_nopiv(panel)
-        elif method == "tntpiv":
-            lu, perm = panel_lu_tournament(panel, block_rows=max(ib, nb))
-        else:
-            lu, perm = panel_lu(panel)
-        lut = lu.reshape(Nt - k, nb, nb)
+        def super_step(k, carry, W0=W0, W=W, nbundle=nbundle, S=S, T=T,
+                       k0=k0):
+            a_loc, perm_g = carry
+            rk, ck = k % p, k % q
+            kkr = k // p
+            vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
 
-        # ---- batched row exchange for ALL columns (left + right + panel;
-        #      panel values rewritten below) ----
-        if method != "nopiv":
-            iota = jnp.arange(W)
-            nbundle = min(2 * nb, W)
-            displaced = lax.top_k((perm != iota).astype(jnp.int32),
-                                  nbundle)[1]
-            out_rows = displaced + k * nb
-            in_rows = perm[displaced] + k * nb
-            a_loc = _row_bundle_exchange(a_loc, out_rows, in_rows, k * nb,
-                                         p, r, nbundle)
-            pw = perm_g[k * nb:k * nb + W]
-            perm_g = lax.dynamic_update_slice(perm_g, pw[perm], (k * nb,))
+            # ---- gather + factor the panel (replicated) ----
+            gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+            panel = gpan[k0:Nt].reshape(W, nb)   # static slice
+            # roll active rows (>= k) to the top, zero the factored tail
+            shift = (k - k0) * nb
+            panel = jnp.roll(panel, -shift, axis=0)
+            rows = jnp.arange(W)
+            panel = jnp.where((rows < (Nt - k) * nb)[:, None], panel,
+                              jnp.zeros_like(panel))
+            # ragged final tile: identity-augment its pad block (only the
+            # last panel has vk < nb, and it is then the top tile)
+            panel = panel + jnp.concatenate(
+                [jnp.diag((idx >= vk).astype(dt)),
+                 jnp.zeros((W - nb, nb), dt)], axis=0)
+            if method == "nopiv":
+                lu, perm = panel_lu_nopiv(panel)
+            elif method == "tntpiv":
+                lu, perm = panel_lu_tournament(panel,
+                                               block_rows=max(ib, nb))
+            else:
+                lu, perm = panel_lu(panel)
+            lut = lu.reshape(W0, nb, nb)
 
-        # ---- write the factored panel column back (owners in col ck) ----
-        gi_all = r + p * jnp.arange(mtl)         # global tile row per slot
-        ltiles_all = jnp.take(lut, jnp.clip(gi_all - k, 0, Nt - k - 1),
-                              axis=0)            # [mtl, nb, nb]
-        newcol = jnp.where((gi_all >= k)[:, None, None], ltiles_all,
-                           a_loc[:, kkc])
-        a_loc = jnp.where(c == ck, a_loc.at[:, kkc].set(newcol), a_loc)
+            # ---- batched row exchange for ALL columns (left + right +
+            #      panel; panel values rewritten below) ----
+            if method != "nopiv":
+                iota = jnp.arange(W)
+                displaced = lax.top_k((perm != iota).astype(jnp.int32),
+                                      nbundle)[1]
+                out_rows = displaced + k * nb
+                in_rows = perm[displaced] + k * nb
+                a_loc = _row_bundle_exchange(a_loc, out_rows, in_rows, p, r,
+                                             nbundle)
+                pw = lax.dynamic_slice(perm_g, (k * nb,), (W,))
+                perm_g = lax.dynamic_update_slice(perm_g, pw[perm],
+                                                  (k * nb,))
 
-        if k == Nt - 1:
-            break
+            # ---- write the factored panel column back (owners col ck) ----
+            ltiles_all = jnp.take(lut, jnp.clip(gi_all - k, 0, W0 - 1),
+                                  axis=0)        # [mtl, nb, nb]
+            oldcol = lax.dynamic_index_in_dim(a_loc, k // q, axis=1,
+                                              keepdims=False)
+            newcol = jnp.where((gi_all >= k)[:, None, None], ltiles_all,
+                               oldcol)
+            col_sel = jnp.where(c == ck, newcol, oldcol)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None],
+                (zi, (k // q).astype(jnp.int32), zi, zi))
 
-        # ---- U12: row-k owners solve against unit-lower L11, bcast ----
-        l11 = lut[0]
-        urow = a_loc[kkr]                        # [ntl, nb, nb] my row k
-        u12 = jax.vmap(lambda t: lax.linalg.triangular_solve(
-            l11, t, left_side=True, lower=True, unit_diagonal=True))(urow)
-        u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
-        u12 = lax.psum(u12, AXIS_P)              # all ranks, their own cols
-        gj_all = c + q * jnp.arange(ntl)
-        newrow = jnp.where((gj_all > k)[:, None, None], u12, a_loc[kkr])
-        a_loc = jnp.where(r == rk, a_loc.at[kkr].set(newrow), a_loc)
+            def tail(carry):
+                a_loc, perm_g = carry
+                # ---- U12: row-k owners solve vs unit-lower L11, bcast ----
+                l11 = lut[0]
+                urow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                                keepdims=False)
+                u12 = jax.vmap(lambda t: lax.linalg.triangular_solve(
+                    l11, t, left_side=True, lower=True,
+                    unit_diagonal=True))(urow)
+                u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
+                u12 = lax.psum(u12, AXIS_P)      # all ranks, their own cols
+                gj_all = c + q * jnp.arange(ntl)
+                newrow = jnp.where((gj_all > k)[:, None, None], u12, urow)
+                row_sel = jnp.where(r == rk, newrow, urow)
+                a_loc = lax.dynamic_update_slice(
+                    a_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
 
-        # ---- trailing update on static-size slice ----
-        S = mtl - max(0, (k + 1) // p)
-        T = ntl - max(0, (k + 1) // q)
-        if S <= 0 or T <= 0:
-            continue
-        sr = jnp.clip((k + 1 - r + p - 1) // p, 0, mtl - S)
-        sc = jnp.clip((k + 1 - c + q - 1) // q, 0, ntl - T)
-        gi = r + p * (sr + jnp.arange(S))
-        gj = c + q * (sc + jnp.arange(T))
-        lrows = jnp.take(lut, jnp.clip(gi - k, 0, Nt - k - 1), axis=0)
-        ucols = lax.dynamic_slice(u12, (sc, jnp.zeros((), sc.dtype),
-                                        jnp.zeros((), sc.dtype)),
-                                  (T, nb, nb))
-        upd = jnp.einsum("iab,jbc->ijac", lrows, ucols,
-                         preferred_element_type=dt)
-        z = jnp.zeros((), sr.dtype)
-        cur = lax.dynamic_slice(a_loc, (sr, sc, z, z), (S, T, nb, nb))
-        mask = ((gi > k)[:, None, None, None] & (gj > k)[None, :, None, None])
-        a_loc = lax.dynamic_update_slice(
-            a_loc, jnp.where(mask, cur - upd, cur), (sr, sc, z, z))
+                # ---- trailing update on the static-size slice ----
+                sr = jnp.clip(-(-(k0 + 1 - r) // p), 0,
+                              mtl - S).astype(jnp.int32)
+                sc = jnp.clip(-(-(k0 + 1 - c) // q), 0,
+                              ntl - T).astype(jnp.int32)
+                gi = r + p * (sr + jnp.arange(S))
+                gj = c + q * (sc + jnp.arange(T))
+                lrows = jnp.take(lut, jnp.clip(gi - k, 0, W0 - 1), axis=0)
+                lrows = jnp.where((gi > k)[:, None, None], lrows,
+                                  jnp.zeros_like(lrows))
+                ucols = lax.dynamic_slice(u12, (sc, zi, zi), (T, nb, nb))
+                ucols = jnp.where((gj > k)[:, None, None], ucols,
+                                  jnp.zeros_like(ucols))
+                upd = jnp.einsum("iab,jbc->ijac", lrows, ucols,
+                                 preferred_element_type=dt)
+                cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
+                                        (S, T, nb, nb))
+                mask = ((gi > k)[:, None, None, None] &
+                        (gj > k)[None, :, None, None])
+                a_loc = lax.dynamic_update_slice(
+                    a_loc, jnp.where(mask, cur - upd, cur), (sr, sc, zi, zi))
+                return a_loc, perm_g
 
-    return a_loc, perm_g
+            if S > 0 and T > 0:
+                a_loc, perm_g = lax.cond(k < Nt - 1, tail,
+                                         lambda cr: cr, (a_loc, perm_g))
+            return a_loc, perm_g
+
+        a_loc, perm_g = lax.fori_loop(k0, k1, super_step, (a_loc, perm_g))
+
+    return a_loc, perm_g[:m_pad]
+
+
+def dist_permute_rows(b_data, perm, grid: Grid):
+    """Sharded application of a global row permutation:
+    new B[g, :] = old B[perm[g], :] (the getrs pivot-apply,
+    ref: src/getrs.cc permuteRows + internal_swap.cc batches).
+
+    Each rank all-gathers its tile-COLUMN strip along the p axis — memory
+    m x n/q per rank, a 1/q slice of the matrix, never a replicated dense
+    copy — then gathers its own rows from the strip."""
+    p, q = grid.p, grid.q
+    mtl = b_data.shape[0] // p
+    mb = b_data.shape[2]
+    m_pad = p * mtl * mb
+    perm_pad = jnp.concatenate(
+        [jnp.asarray(perm),
+         jnp.arange(perm.shape[0], m_pad)]).astype(jnp.int32)
+
+    def local(b_loc, perm_pad):
+        r = lax.axis_index(AXIS_P)
+        ntl = b_loc.shape[1]
+        nbr = b_loc.shape[3]
+        allb = lax.all_gather(b_loc, AXIS_P)       # [p, mtl, ntl, mb, nbr]
+        # element-rows-major view of the full column strip:
+        # global row g at strip index (g//mb % p, g//mb // p, :, g % mb, :)
+        strip = allb.transpose(0, 1, 3, 2, 4).reshape(p * mtl * mb,
+                                                      ntl, nbr)
+        gt = r + p * jnp.arange(mtl)               # my global tile rows
+        gr = (gt[:, None] * mb + jnp.arange(mb)[None, :]).reshape(-1)
+        src = perm_pad[gr]                         # source element rows
+        st_, so = src // mb, src % mb
+        strip_idx = (st_ % p) * (mtl * mb) + (st_ // p) * mb + so
+        mine = strip[strip_idx]                    # [mtl*mb, ntl, nbr]
+        return mine.reshape(mtl, mb, ntl, nbr).transpose(0, 2, 1, 3)
+
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, P()),
+                       out_specs=spec)
+    return fn(b_data, perm_pad)
 
 
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
-               ib: int = 16):
+               ib: int = 16, sb: int | None = None):
     """Factor square cyclic storage in place; returns (data, perm) with
     A[perm] = L @ U (perm over the padded row space, identity on pads)."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
+    sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = jax.shard_map(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
-                                    method, ib),
+                                    method, ib, sb),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P()))
     return fn(data)

@@ -106,27 +106,52 @@ def _panel_apply(C, Vr, Tr, Vs_mine, Ts, conj_trans: bool):
     return _local_apply(C, Vr, Tr, False)
 
 
+def _all_panel_tables(Kt: int, Mt: int, m: int, nb: int, p: int):
+    """Stack _panel_tables over every k: [Kt, p] skips, [Kt, p, nb] stack
+    positions — indexed with the traced k inside the fori_loop bodies."""
+    skips = np.zeros((Kt, p), np.int32)
+    poss = np.zeros((Kt, p, nb), np.int32)
+    for k in range(Kt):
+        skips[k], _, poss[k] = _panel_tables(k, Mt, m, nb, p)
+    return jnp.asarray(skips), jnp.asarray(poss)
+
+
 def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
+    """ONE lax.fori_loop over the Kt panels (per-step shapes are
+    k-independent, so no superblocking is needed — the compiled program is
+    O(1) in Kt)."""
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
     dt = a_loc.dtype
     tile_idx = jnp.arange(mtl)
+    skips, poss = _all_panel_tables(Kt, Mt, m, nb, p)
+    gi_all = r + p * tile_idx
+    gj_all = c + q * jnp.arange(ntl)
 
-    Tloc = jnp.zeros((Kt, nb, nb), dt)
-    Vtree = jnp.zeros((Kt, p * nb, nb), dt)
-    Ttree = jnp.zeros((Kt, nb, nb), dt)
+    # Initial carries must carry the same device-variance the loop body
+    # produces: Tr varies over mesh rows (p) but is bcast along q; the tree
+    # factors are psum-replicated everywhere (out_specs P() relies on it).
+    def _pvary(x, axes):
+        try:
+            return lax.pcast(x, axes, to="varying")
+        except (AttributeError, TypeError):
+            return lax.pvary(x, axes)
 
-    for k in range(Kt):
+    Tloc0 = _pvary(jnp.zeros((Kt, nb, nb), dt), (AXIS_P,))
+    Vtree0 = jnp.zeros((Kt, p * nb, nb), dt)
+    Ttree0 = jnp.zeros((Kt, nb, nb), dt)
+
+    def step(k, carry):
+        a_loc, Tloc, Vtree, Ttree = carry
         rk, ck = k % p, k % q
         kkc = k // q
-        skip_t, _, pos_t = _panel_tables(k, Mt, m, nb, p)
-        skip = jnp.asarray(skip_t)[r]
-        posr = jnp.asarray(pos_t)[r]
+        skip = skips[k, r]
+        posr = poss[k, r]
 
         # ---- local panel QR on my rolled rows of tile-column k ----
-        pan = a_loc[:, kkc]                      # [mtl, nb, nb]
-        gi_all = r + p * tile_idx
+        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+        pan0 = pan
         pan = jnp.where((gi_all >= k)[:, None, None], pan,
                         jnp.zeros_like(pan))
         pan = jnp.roll(pan, -skip, axis=0)
@@ -158,12 +183,13 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
         head = jnp.where(r == rk, head + Rfin, head)
         vstore = packed.at[:nb].set(head)
         vtiles = _rows_unview(vstore, skip, mtl, 1, nb)[:, 0]
-        newcol = jnp.where((gi_all >= k)[:, None, None], vtiles,
-                           a_loc[:, kkc])
-        a_loc = jnp.where(c == ck, a_loc.at[:, kkc].set(newcol), a_loc)
+        newcol = jnp.where((gi_all >= k)[:, None, None], vtiles, pan0)
+        col_sel = jnp.where(c == ck, newcol, pan0)
+        zi = jnp.zeros((), jnp.int32)
+        a_loc = lax.dynamic_update_slice(
+            a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
 
         # ---- trailing update: Q^H on columns gj > k (one psum for tree) ----
-        gj_all = c + q * jnp.arange(ntl)
         Cl = _rows_view(a_loc, skip)             # [mtl*nb, ntl*nb]
         colmask = jnp.repeat(gj_all > k, nb)[None, :]
         Cm = jnp.where(colmask, Cl, jnp.zeros_like(Cl))
@@ -173,8 +199,9 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
         rowmask = (gi_all >= k)[:, None, None, None]
         cmask = (gj_all > k)[None, :, None, None]
         a_loc = jnp.where(rowmask & cmask, newt, a_loc)
+        return a_loc, Tloc, Vtree, Ttree
 
-    return a_loc, Tloc, Vtree, Ttree
+    return lax.fori_loop(0, Kt, step, (a_loc, Tloc0, Vtree0, Ttree0))
 
 
 def dist_geqrf_data(data, Kt, Mt, m, n, grid: Grid):
@@ -198,18 +225,18 @@ def _unmqr_local(a_loc, c_loc, Tloc, Vtree, Ttree, Kt, Mt, m, p, q,
     nb = a_loc.shape[-1]
     tile_idx = jnp.arange(mtl)
     Tl = Tloc[0]                                  # [Kt, nb, nb] my mesh row
+    skips, poss = _all_panel_tables(Kt, Mt, m, nb, p)
+    gi_all = r + p * tile_idx
 
-    ks = range(Kt) if conj_trans else range(Kt - 1, -1, -1)
-    for k in ks:
+    def step(t, c_loc):
+        k = t if conj_trans else Kt - 1 - t
         rk, ck = k % p, k % q
         kkc = k // q
-        skip_t, _, pos_t = _panel_tables(k, Mt, m, nb, p)
-        skip = jnp.asarray(skip_t)[r]
-        posr = jnp.asarray(pos_t)[r]
+        skip = skips[k, r]
+        posr = poss[k, r]
 
         # rebuild my local V for panel k from stored tiles
-        pan = a_loc[:, kkc]
-        gi_all = r + p * tile_idx
+        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
         pan = jnp.where((gi_all >= k)[:, None, None], pan,
                         jnp.zeros_like(pan))
         pan = jnp.roll(pan, -skip, axis=0)
@@ -232,9 +259,9 @@ def _unmqr_local(a_loc, c_loc, Tloc, Vtree, Ttree, Kt, Mt, m, p, q,
         Cn = _panel_apply(Cl, Vr, Tr, Vs_mine, Ts, conj_trans)
         newt = _rows_unview(Cn, skip, mtl, ntl_c, nb)
         rowmask = (gi_all >= k)[:, None, None, None]
-        c_loc = jnp.where(rowmask, newt, c_loc)
+        return jnp.where(rowmask, newt, c_loc)
 
-    return c_loc
+    return lax.fori_loop(0, Kt, step, c_loc)
 
 
 def dist_unmqr_data(a_data, c_data, Tloc, Vtree, Ttree, Kt, Mt, m,

@@ -14,7 +14,8 @@ how work::trsm walks the transposed matrix (work_trsm.cc).
 Right-side solves are mapped to left solves by the driver via
 X op(A) = B  <=>  op(A)^T X^T = B^T (ref: trsm.cc does the same with views).
 
-Structure per step k (inside ONE unrolled shard_map program):
+Structure per step k (inside ONE shard_map program, superblocked like
+dist_chol — ~SUPERBLOCKS unrolled bodies, lax.fori_loop inside each):
   1. gather diag tile A(k,k), build effective triangle, replicate
   2. ranks owning B(k, :) solve their RHS tiles (vmapped triangular_solve)
   3. broadcast X(k, :) along the p axis; broadcast the effective panel
@@ -33,80 +34,78 @@ from jax.sharding import PartitionSpec as P
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.trsm import apply_op_tile
 from ..types import Op, Uplo
+from .dist_chol import superblock
 
 
 def _trsm_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a, unit_diag,
-                mtl_a, ntl_a, mtl_b, ntl_b):
+                mtl_a, ntl_a, mtl_b, ntl_b, sb):
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
     nbr = b_loc.shape[-1]
     dt = b_loc.dtype
+    idx = jnp.arange(nb)
+    zi = jnp.zeros((), jnp.int32)
 
     b_loc = alpha * b_loc
 
     eff_lower = lower if op_a is Op.NoTrans else not lower
-    order = range(Nt) if eff_lower else range(Nt - 1, -1, -1)
 
-    for k in order:
+    def step(k, b_loc):
+        """Solve block row k and broadcast X(k,:) + A's effective panel."""
         rk, ck = k % p, k % q
         kkr, kkc = k // p, k // q
 
-        # -- effective diagonal tile (pad diagonal identity-augmented so the
-        # ragged last tile stays nonsingular; B's pad rows are zero so the
-        # pad solution is exactly zero) --
-        vk = nb if k < Nt - 1 else n - (Nt - 1) * nb
-        idx = jnp.arange(nb)
+        # -- effective diagonal tile (pad diagonal identity-augmented so
+        # the ragged last tile stays nonsingular; B's pad rows are zero so
+        # the pad solution is exactly zero) --
+        vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
         pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
-        dtile = jnp.where((r == rk) & (c == ck), a_loc[kkr, kkc],
+        dtile = lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+            kkc, axis=0, keepdims=False)
+        dtile = jnp.where((r == rk) & (c == ck), dtile,
                           jnp.zeros((nb, nb), a_loc.dtype))
         dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
         deff = apply_op_tile(dtile, op_a) + pad_eye
 
         # -- solve block row k of B on its owner row, bcast along p --
-        brow = b_loc[kkr]                           # [ntl_b, nb, nbr]
+        brow = lax.dynamic_index_in_dim(b_loc, kkr, axis=0, keepdims=False)
         xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
             deff, bb, left_side=True, lower=eff_lower,
             unit_diagonal=unit_diag))(brow)
         xk = jnp.where(r == rk, xk, jnp.zeros_like(xk))
         xk = lax.psum(xk, AXIS_P)                   # replicated down columns
-        b_loc = jnp.where(r == rk, b_loc.at[kkr].set(xk), b_loc)
-
-        # remaining rows to update: i > k (fwd) or i < k (bwd)
-        rem = (Nt - 1 - k) if eff_lower else k
-        if rem == 0:
-            continue
+        row_sel = jnp.where(r == rk, xk, brow)
+        b_loc = lax.dynamic_update_slice(
+            b_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
 
         # -- effective panel column k of A, as a global buffer --
-        # op == NoTrans: tiles A(i, k) live in mesh column ck at local col kkc
-        # op != NoTrans: tiles op(A(k, i)) live in mesh row rk at local row kkr
+        # op == NoTrans: tiles A(i, k) live in mesh col ck at local col kkc
+        # op != NoTrans: tiles op(A(k, i)) live in mesh row rk, local row kkr
         if op_a is Op.NoTrans:
-            pan = a_loc[:, kkc]                     # [mtl_a, nb, nb]
-            gi_all = r + p * jnp.arange(mtl_a)
+            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                           keepdims=False)
+            gi_a = r + p * jnp.arange(mtl_a)
             buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gi_all].set(pan)
+            buf = buf.at[gi_a].set(pan)
             buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
         else:
-            pan = apply_op_tile(a_loc[kkr], op_a)   # [ntl_a, nb, nb]
-            gj_all = c + q * jnp.arange(ntl_a)
+            arow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                            keepdims=False)
+            pan = apply_op_tile(arow, op_a)         # [ntl_a, nb, nb]
+            gj_a = c + q * jnp.arange(ntl_a)
             buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gj_all].set(pan)
+            buf = buf.at[gj_a].set(pan)
             buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
         gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+        return b_loc, xk, gpan
 
-        # -- update this rank's remaining local rows --
-        S = mtl_b - max(0, (k + 1) // p) if eff_lower \
-            else -(-k // p)                        # max local rows with i<k
-        if S <= 0:
-            continue
-        if eff_lower:
-            sr = jnp.clip((k + 1 - r + p - 1) // p, 0, mtl_b - S)
-        else:
-            sr = jnp.zeros((), r.dtype)
+    def update(b_loc, k, xk, gpan, S, sr):
+        """B(i,:) -= Aeff(i,k) @ X(k,:) on the not-yet-solved window."""
         gi = r + p * (sr + jnp.arange(S))
-        arow = gpan[gi]                             # [S, nb, nb] Aeff(i, k)
-        z = jnp.zeros((), r.dtype)
-        cur = lax.dynamic_slice(b_loc, (sr.astype(r.dtype), z, z, z),
+        arow = gpan[gi]                             # [S, nb, nb]
+        cur = lax.dynamic_slice(b_loc, (sr, zi, zi, zi),
                                 (S, ntl_b, nb, nbr))
         upd = jnp.einsum("iab,jbc->ijac", arow, xk,
                          preferred_element_type=dt)
@@ -115,26 +114,198 @@ def _trsm_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a, unit_diag,
         else:
             mask = (gi < k)[:, None, None, None]
         new = jnp.where(mask, cur - upd, cur)
-        b_loc = lax.dynamic_update_slice(b_loc, new,
-                                         (sr.astype(r.dtype), z, z, z))
+        return lax.dynamic_update_slice(b_loc, new, (sr, zi, zi, zi))
+
+    if eff_lower:
+        for k0 in range(0, Nt, sb):
+            k1 = min(k0 + sb, Nt)
+            S = mtl_b - ((k0 + 1) // p)             # rows that can be > k0
+            S = max(S, 1)                           # degenerate, masked out
+
+            def fwd(k, b_loc, S=S):
+                b_loc, xk, gpan = step(k, b_loc)
+
+                def upd_fn(b):
+                    sr = jnp.clip(-(-(k0 + 1 - r) // p), 0,
+                                  mtl_b - S).astype(jnp.int32)
+                    return update(b, k, xk, gpan, S, sr)
+
+                return lax.cond(k < Nt - 1, upd_fn, lambda b: b, b_loc)
+
+            b_loc = lax.fori_loop(k0, k1, fwd, b_loc)
+    else:
+        for k0 in reversed(range(0, Nt, sb)):
+            k1 = min(k0 + sb, Nt)
+            S = max(-(-k1 // p), 1)                 # rows that can be < k1
+
+            def bwd(t, b_loc, S=S, k1=k1):
+                k = k1 - 1 - t
+
+                b_loc, xk, gpan = step(k, b_loc)
+
+                def upd_fn(b):
+                    sr = jnp.zeros((), jnp.int32)
+                    return update(b, k, xk, gpan, S, sr)
+
+                return lax.cond(k > 0, upd_fn, lambda b: b, b_loc)
+
+            b_loc = lax.fori_loop(0, k1 - k0, bwd, b_loc)
 
     return b_loc
 
 
+def _trsm_right_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a,
+                      unit_diag, mtl_a, ntl_a, mtl_b, ntl_b, sb):
+    """Right-side solve X op(A) = alpha B by column-block substitution —
+    the mirror of _trsm_local with the q axis in the starring role (so no
+    dense transpose round-trip is ever needed, ref: trsm.cc handles Right
+    with views the same way)."""
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    mbr = b_loc.shape[-2]
+    dt = b_loc.dtype
+    idx = jnp.arange(nb)
+    zi = jnp.zeros((), jnp.int32)
+
+    b_loc = alpha * b_loc
+
+    eff_lower = lower if op_a is Op.NoTrans else not lower
+    # X Aeff = B: lower Aeff couples column k to LATER columns -> walk
+    # k downward; upper walks upward
+
+    def step(k, b_loc):
+        rk, ck = k % p, k % q
+        kkr, kkc = k // p, k // q
+
+        vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
+        pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
+        dtile = lax.dynamic_index_in_dim(
+            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+            kkc, axis=0, keepdims=False)
+        dtile = jnp.where((r == rk) & (c == ck), dtile,
+                          jnp.zeros((nb, nb), a_loc.dtype))
+        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+        deff = apply_op_tile(dtile, op_a) + pad_eye
+
+        # -- solve block column k of B on its owner column, bcast along q --
+        bcol = lax.dynamic_index_in_dim(b_loc, kkc, axis=1, keepdims=False)
+        xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
+            deff, bb, left_side=False, lower=eff_lower,
+            unit_diagonal=unit_diag))(bcol)
+        xk = jnp.where(c == ck, xk, jnp.zeros_like(xk))
+        xk = lax.psum(xk, AXIS_Q)                   # replicated across rows
+        col_sel = jnp.where(c == ck, xk, bcol)
+        b_loc = lax.dynamic_update_slice(
+            b_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+
+        # -- effective row k of A as a global buffer over tile columns --
+        # op == NoTrans: tiles A(k, j) live in mesh row rk at local row kkr
+        # op != NoTrans: tiles op(A(j, k)) live in mesh col ck, local col kkc
+        if op_a is Op.NoTrans:
+            pan = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                           keepdims=False)
+            gj_a = c + q * jnp.arange(ntl_a)
+            buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
+            buf = buf.at[gj_a].set(pan)
+            buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
+        else:
+            acol = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                            keepdims=False)
+            pan = apply_op_tile(acol, op_a)         # [mtl_a, nb, nb]
+            gi_a = r + p * jnp.arange(mtl_a)
+            buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
+            buf = buf.at[gi_a].set(pan)
+            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+        return b_loc, xk, gpan
+
+    def update(b_loc, k, xk, gpan, T, sc):
+        gj = c + q * (sc + jnp.arange(T))
+        acol = gpan[gj]                             # [T, nb, nb] Aeff(k, j)
+        cur = lax.dynamic_slice(b_loc, (zi, sc, zi, zi),
+                                (mtl_b, T, mbr, nb))
+        upd = jnp.einsum("iab,jbc->ijac", xk, acol,
+                         preferred_element_type=dt)
+        if eff_lower:
+            mask = (gj < k)[None, :, None, None]
+        else:
+            mask = (gj > k)[None, :, None, None]
+        new = jnp.where(mask, cur - upd, cur)
+        return lax.dynamic_update_slice(b_loc, new, (zi, sc, zi, zi))
+
+    if eff_lower:
+        # columns solved from high k downward; updates hit columns < k
+        for k0 in reversed(range(0, Nt, sb)):
+            k1 = min(k0 + sb, Nt)
+            T = max(-(-k1 // q), 1)
+
+            def bwd(t, b_loc, T=T, k1=k1):
+                k = k1 - 1 - t
+                b_loc, xk, gpan = step(k, b_loc)
+
+                def upd_fn(b):
+                    return update(b, k, xk, gpan, T, jnp.zeros((), jnp.int32))
+
+                return lax.cond(k > 0, upd_fn, lambda b: b, b_loc)
+
+            b_loc = lax.fori_loop(0, k1 - k0, bwd, b_loc)
+    else:
+        for k0 in range(0, Nt, sb):
+            k1 = min(k0 + sb, Nt)
+            T = max(ntl_b - ((k0 + 1) // q), 1)
+
+            def fwd(k, b_loc, T=T):
+                b_loc, xk, gpan = step(k, b_loc)
+
+                def upd_fn(b):
+                    sc = jnp.clip(-(-(k0 + 1 - c) // q), 0,
+                                  ntl_b - T).astype(jnp.int32)
+                    return update(b, k, xk, gpan, T, sc)
+
+                return lax.cond(k < Nt - 1, upd_fn, lambda b: b, b_loc)
+
+            b_loc = lax.fori_loop(k0, k1, fwd, b_loc)
+
+    return b_loc
+
+
+def dist_trsm_right(a_data, b_data, alpha, *, Nt, grid: Grid, lower: bool,
+                    op_a: Op, unit_diag: bool, n: int | None = None,
+                    sb: int | None = None):
+    """Solve X op(A) = alpha B; returns X in B's cyclic storage layout."""
+    mtl_a = a_data.shape[0] // grid.p
+    ntl_a = a_data.shape[1] // grid.q
+    mtl_b = b_data.shape[0] // grid.p
+    ntl_b = b_data.shape[1] // grid.q
+    n = n if n is not None else Nt * a_data.shape[-1]
+    sb = sb if sb is not None else superblock(Nt)
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, b: _trsm_right_local(
+            a, b, alpha, Nt=Nt, n=n, p=grid.p, q=grid.q, lower=lower,
+            op_a=op_a, unit_diag=unit_diag, mtl_a=mtl_a, ntl_a=ntl_a,
+            mtl_b=mtl_b, ntl_b=ntl_b, sb=sb),
+        mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(a_data, b_data)
+
+
 def dist_trsm_left(a_data, b_data, alpha, *, Nt, grid: Grid, lower: bool,
-                   op_a: Op, unit_diag: bool, n: int | None = None):
+                   op_a: Op, unit_diag: bool, n: int | None = None,
+                   sb: int | None = None):
     """Solve op(A) X = alpha B; returns X in B's cyclic storage layout."""
     mtl_a = a_data.shape[0] // grid.p
     ntl_a = a_data.shape[1] // grid.q
     mtl_b = b_data.shape[0] // grid.p
     ntl_b = b_data.shape[1] // grid.q
     n = n if n is not None else Nt * a_data.shape[-1]
+    sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = jax.shard_map(
         lambda a, b: _trsm_local(
             a, b, alpha, Nt=Nt, n=n, p=grid.p, q=grid.q, lower=lower,
             op_a=op_a,
             unit_diag=unit_diag, mtl_a=mtl_a, ntl_a=ntl_a, mtl_b=mtl_b,
-            ntl_b=ntl_b),
+            ntl_b=ntl_b, sb=sb),
         mesh=grid.mesh, in_specs=(spec, spec), out_specs=spec)
     return fn(a_data, b_data)
