@@ -70,7 +70,12 @@ def _refine(A: Matrix, B: Matrix, solve_lo, opts: Options | None):
     r0 = _residual(A, x0, B, opts)
 
     def is_conv(x, r):
-        return aux.norm(Norm.Max, r) <= aux.norm(Norm.Max, x) * anorm * tol
+        # per-column test (ref: gesv_mixed.cc:188-193 iterRefConverged uses
+        # colNorms(Max) — a block-global max could declare a badly scaled
+        # column converged on the strength of another column's large ||x||)
+        rn = aux.col_norms(r)
+        xn = aux.col_norms(x)
+        return jnp.all(rn <= xn * anorm * tol)
 
     def cond(state):
         _, _, it, conv = state
@@ -189,21 +194,28 @@ def _gmres_ir(A: Matrix, B: Matrix, solve_lo, opts: Options | None,
             w, H = lax.fori_loop(0, restart + 1, mgs, (w, H))
             hn = jnp.linalg.norm(w, axis=0)
             H = H.at[i + 1, i].set(hn.astype(dt))
-            V = V.at[i + 1].set(w / (hn[None, :] + 1e-300))
+            # happy breakdown (hn == 0): keep a zero basis vector instead of
+            # NaN — the column is already converged in this subspace
+            ok = hn[None, :] > 0
+            V = V.at[i + 1].set(jnp.where(ok, w / jnp.where(ok, hn, 1), 0))
             return V, H
 
         V, H = lax.fori_loop(0, restart, arn_step, (V0, H0))
 
-        # per-column least squares: min_y ||beta e1 - H_j y|| via normal
-        # equations on the (restart+1) x restart Hessenberg (tiny, well
-        # scaled after orthonormalization)
+        # per-column least squares: min_y ||beta e1 - H_j y|| via batched QR
+        # of the (restart+1) x restart Hessenberg (ref uses Givens rotation
+        # updates — same triangular solve, built all at once here)
         Hc = jnp.transpose(H, (2, 0, 1))                   # [nrhs, m+1, m]
         rhs = jnp.zeros((nrhs, restart + 1), dt).at[:, 0].set(
             beta.astype(dt))
-        G = jnp.einsum("nij,nik->njk", jnp.conj(Hc), Hc)
-        G = G + eps(dt) * jnp.eye(restart, dtype=dt)[None]
-        gb = jnp.einsum("nij,ni->nj", jnp.conj(Hc), rhs)
-        y = jnp.linalg.solve(G, gb[..., None])[..., 0]     # [nrhs, m]
+        Q, R = jnp.linalg.qr(Hc)                           # reduced QR
+        qb = jnp.einsum("nij,ni->nj", jnp.conj(Q), rhs)    # [nrhs, m]
+        # guard exactly-singular R (breakdown columns): unit diagonal
+        diag = jnp.abs(jnp.diagonal(R, axis1=-2, axis2=-1))
+        shift = jnp.where(diag > 0, 0.0, 1.0).astype(dt)
+        R = R + shift[..., None] * jnp.eye(restart, dtype=dt)[None]
+        y = jax.scipy.linalg.solve_triangular(R, qb[..., None],
+                                              lower=False)[..., 0]
         # x += M^-1 (V y)   (right preconditioning is linear)
         vy = jnp.einsum("inr,ir->nr", V[:restart], y.T)
         dx = prec(vy)

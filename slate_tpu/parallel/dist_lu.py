@@ -225,7 +225,14 @@ def dist_permute_rows(b_data, perm, grid: Grid):
 
     Each rank all-gathers its tile-COLUMN strip along the p axis — memory
     m x n/q per rank, a 1/q slice of the matrix, never a replicated dense
-    copy — then gathers its own rows from the strip."""
+    copy — then gathers its own rows from the strip.
+
+    Works for any B row tiling, including mb != the LU's nb: ``perm``
+    entries for real rows are always < m (dist_getrf zeroes factored/pad
+    tail rows so they lose every pivot contest, and the ragged pad block is
+    identity-augmented), real element-row indices are tiling-independent,
+    and ``perm_pad`` extends identity over B's OWN padded row space here.
+    tests/test_lu.py::test_mesh_getrs_mismatched_b_tiling covers this."""
     p, q = grid.p, grid.q
     mtl = b_data.shape[0] // p
     mb = b_data.shape[2]

@@ -115,6 +115,22 @@ def test_gesv_tntpiv_mesh(rng):
     assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-11
 
 
+def test_mesh_getrs_mismatched_b_tiling(rng):
+    """Mesh getrs fast path with B.mb != LU.nb (B pads differently):
+    dist_permute_rows builds perm_pad over B's own padded row space, so
+    mismatched tilings must still solve exactly (ADVICE r3 invariant)."""
+    n, nb, mbB = 22, 5, 4         # LU tiles 5x5, B tiles 4-wide rows
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = adversarial(rng, n)
+    b = rng.standard_normal((n, 3))
+    F = st.getrf(st.Matrix.from_numpy(a, nb, nb, g))
+    B = st.Matrix.from_numpy(b, mbB, 3, g)
+    x = st.getrs(F, B).to_numpy()
+    resid = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                         np.linalg.norm(x) * n)
+    assert resid < 1e-14
+
+
 def test_getri(rng):
     n = 16
     a = rng.standard_normal((n, n)) + n * np.eye(n)
