@@ -1,0 +1,50 @@
+"""Shared setup for the examples: force an 8-device virtual CPU mesh so
+every example exercises the distributed path on any machine (the analog of
+the reference running examples under mpirun -np 4, ref:
+examples/run_tests.py, docs/usage.md:32-42).
+
+Virtual devices only exist if the flag lands before jax's backend
+initializes, and site hooks may import/initialize jax before any example
+code runs — so importing this module RE-EXECS the script in a child
+process with a scrubbed environment (same recipe as __graft_entry__.py),
+then the child imports jax normally.  Import _common FIRST in every
+example."""
+
+import os
+import subprocess
+import sys
+
+_MARKER = "_SLATE_TPU_EXAMPLES_CHILD"
+
+if os.environ.get(_MARKER) != "1":
+    env = dict(os.environ)
+    env[_MARKER] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # site hook would re-add TPU
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    res = subprocess.run([sys.executable] + sys.argv, env=env)
+    raise SystemExit(res.returncode)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+
+def rng():
+    return np.random.default_rng(1234)
+
+
+def report(name: str, resid: float, tol: float = 1e-10):
+    status = "PASS" if resid < tol else "FAIL"
+    print(f"{name:<34s} resid {resid:9.2e}  {status}")
+    if resid >= tol:
+        raise SystemExit(f"{name} failed: {resid} >= {tol}")

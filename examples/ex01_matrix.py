@@ -1,0 +1,34 @@
+"""ex01: creating distributed matrices (ref: examples/ex01_matrix.cc).
+
+Build matrices from host data onto a 2D process grid, inspect the
+block-cyclic tile map, and round-trip back to host."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import slate_tpu as st
+
+
+def main():
+    r = rng()
+    grid = st.Grid(2, 4, devices=jax.devices()[:8])
+    m, n, nb = 40, 28, 8
+    a = r.standard_normal((m, n))
+
+    A = st.Matrix.from_numpy(a, nb, nb, grid)
+    assert (A.m, A.n) == (m, n)
+    assert (A.mt, A.nt) == (5, 4)          # ceil(40/8), ceil(28/8)
+    # distribution lambdas (ref: MatrixStorage tileRank/tileMb)
+    assert A.storage.tile_mb(4) == 8 and A.storage.tile_nb(3) == 4
+    assert A.storage.tile_rank(0, 0) == 0
+    report("ex01 from_numpy round-trip", float(np.abs(A.to_numpy() - a).max()))
+
+    Z = st.Matrix.zeros(16, 16, 4, 4, grid, a.dtype)
+    assert np.all(Z.to_numpy() == 0)
+    print(f"ex01 tile map: {A.storage}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""ex03: submatrix and transpose views (ref: ex03_submatrix.cc).
+
+sub() selects a tile-aligned block; transpose/conj_transpose are
+metadata-only op flips, exactly the reference's view semantics."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import slate_tpu as st
+
+
+def main():
+    r = rng()
+    grid = st.Grid(2, 2, devices=jax.devices()[:4])
+    m, n, nb = 32, 32, 8
+    a = r.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb, nb, grid)
+
+    S = A.sub(1, 2, 0, 1)                  # tile rows 1:2, tile cols 0:1
+    report("ex03 sub view", float(np.abs(
+        S.to_numpy() - a[8:24, 0:16]).max()))
+
+    T = A.transpose()
+    report("ex03 transpose view", float(np.abs(T.to_numpy() - a.T).max()))
+
+    # views compose with compute: gemm on a transposed view
+    C = st.gemm(1.0, A.transpose(), A)
+    report("ex03 gemm(A^T, A)", float(np.abs(C.to_numpy() - a.T @ a).max()),
+           1e-9)
+
+
+if __name__ == "__main__":
+    main()

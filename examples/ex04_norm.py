@@ -1,0 +1,41 @@
+"""ex04: matrix norms across types (ref: ex04_norm.cc)."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import slate_tpu as st
+
+
+def main():
+    r = rng()
+    grid = st.Grid(2, 4, devices=jax.devices()[:8])
+    m, n, nb = 36, 28, 8
+    a = r.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb, nb, grid)
+
+    checks = [
+        ("Max", st.Norm.Max, np.abs(a).max()),
+        ("One", st.Norm.One, np.abs(a).sum(axis=0).max()),
+        ("Inf", st.Norm.Inf, np.abs(a).sum(axis=1).max()),
+        ("Fro", st.Norm.Fro, np.linalg.norm(a)),
+    ]
+    for name, nt, ref in checks:
+        got = float(st.norm(nt, A))
+        report(f"ex04 ge norm {name}", abs(got - ref) / ref)
+
+    h = a[:28, :28]
+    H = st.HermitianMatrix.from_numpy(h, nb, grid=grid)
+    hd = np.tril(h) + np.tril(h, -1).T
+    report("ex04 he norm One",
+           abs(float(st.norm(st.Norm.One, H)) -
+               np.abs(hd).sum(axis=0).max()) / np.abs(hd).sum())
+
+    cn = st.col_norms(A)
+    report("ex04 col_norms", float(np.abs(
+        np.asarray(cn) - np.abs(a).max(axis=0)).max()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""ex10: singular value decomposition (ref: ex10_svd.cc)."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import slate_tpu as st
+from slate_tpu import api
+
+
+def main():
+    r = rng()
+    m, n, nb = 40, 24, 8
+    a = r.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb)
+
+    s = api.svd_vals(A)
+    s_ref = np.linalg.svd(a, compute_uv=False)
+    report("ex10 svd_vals", float(np.abs(np.asarray(s) - s_ref).max() /
+                                  s_ref[0]))
+
+    s2, U, V = api.svd(A)
+    ud, vd = U.to_numpy(), V.to_numpy()
+    recon = ud[:, :n] @ np.diag(np.asarray(s2)) @ vd[:, :n].T.conj()
+    report("ex10 svd reconstruct", float(np.abs(recon - a).max() /
+                                         s_ref[0]), 1e-9)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""ex12: generalized Hermitian-definite eigenproblem A x = lambda B x
+(ref: ex12_generalized_hermitian_eig.cc -> hegv)."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import scipy.linalg
+import slate_tpu as st
+
+
+def main():
+    r = rng()
+    n, nb = 24, 6
+    a = r.standard_normal((n, n))
+    sym = (a + a.T) / 2
+    c = r.standard_normal((n, n))
+    spd = c @ c.T + n * np.eye(n)
+    A = st.HermitianMatrix.from_numpy(sym, nb)
+    B = st.HermitianMatrix.from_numpy(spd, nb)
+
+    w, X = st.hegv(A, B)
+    w_ref = scipy.linalg.eigh(sym, spd, eigvals_only=True)
+    report("ex12 hegv values", float(np.abs(np.asarray(w) - w_ref).max() /
+                                     np.abs(w_ref).max()))
+
+    xd = X.to_numpy()
+    report("ex12 hegv residual", float(np.abs(
+        sym @ xd - spd @ xd * np.asarray(w)[None, :]).max() /
+        (np.abs(w_ref).max() * np.linalg.norm(spd))), 1e-10)
+
+
+if __name__ == "__main__":
+    main()

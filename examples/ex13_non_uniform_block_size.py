@@ -1,0 +1,37 @@
+"""ex13: ragged tile sizes (ref: ex13_non_uniform_block_size.cc).
+
+The reference supports arbitrary per-tile sizes via tileMb/tileNb lambdas;
+here tile sizes are uniform with a ragged LAST tile (the padding-discipline
+design, core/storage.py) — this example proves computations are exact when
+no dimension divides the tile size."""
+
+import _common
+from _common import report, rng
+
+import jax
+import numpy as np
+import slate_tpu as st
+
+
+def main():
+    r = rng()
+    grid = st.Grid(2, 2, devices=jax.devices()[:4])
+    m, n, k, nb = 37, 29, 23, 8            # nothing divides 8
+    a = r.standard_normal((m, k))
+    b = r.standard_normal((k, n))
+    A = st.Matrix.from_numpy(a, nb, nb, grid)
+    B = st.Matrix.from_numpy(b, nb, nb, grid)
+    C = st.gemm(1.0, A, B)
+    report("ex13 ragged gemm", float(np.abs(C.to_numpy() - a @ b).max()),
+           1e-10)
+
+    sq = r.standard_normal((37, 37)) + 37 * np.eye(37)
+    bb = r.standard_normal((37, 3))
+    _, X = st.gesv(st.Matrix.from_numpy(sq, 7, 7, grid),
+                   st.Matrix.from_numpy(bb, 7, 7, grid))
+    report("ex13 ragged gesv", float(np.linalg.norm(
+        sq @ X.to_numpy() - bb) / np.linalg.norm(bb)), 1e-10)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""Run every example in-process (the smoke-test tier; ref:
+examples/run_tests.py run in CI, .github/workflows/test.sh:46-61).
+
+One jax runtime (8 virtual CPU devices) is shared across all examples, so
+the whole sweep costs one backend init + per-example compiles."""
+
+import importlib
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _common  # noqa: F401  (forces CPU/8-device/x64 before jax inits)
+
+EXAMPLES = [
+    "ex01_matrix",
+    "ex02_conversion",
+    "ex03_submatrix",
+    "ex04_norm",
+    "ex05_blas",
+    "ex06_linear_system_lu",
+    "ex07_linear_system_cholesky",
+    "ex08_linear_system_indefinite",
+    "ex09_least_squares",
+    "ex10_svd",
+    "ex11_hermitian_eig",
+    "ex12_generalized_hermitian_eig",
+    "ex13_non_uniform_block_size",
+    "ex14_scalapack_gemm",
+]
+
+
+def main():
+    t0 = time.time()
+    failed = []
+    for name in EXAMPLES:
+        t = time.time()
+        try:
+            importlib.import_module(name).main()
+            print(f"== {name} ok ({time.time() - t:.1f}s)")
+        except SystemExit as e:
+            failed.append(name)
+            print(f"== {name} FAILED: {e}")
+        except Exception as e:  # noqa: BLE001
+            failed.append(name)
+            print(f"== {name} ERROR: {type(e).__name__}: {e}")
+    print(f"\n{len(EXAMPLES) - len(failed)}/{len(EXAMPLES)} examples passed "
+          f"in {time.time() - t0:.1f}s")
+    if failed:
+        raise SystemExit(f"failed: {', '.join(failed)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Compatibility APIs: ScaLAPACK descriptors and LAPACK-style shims.
+
+Analog of the reference's compat tier (ref: scalapack_api/, lapack_api/):
+legacy callers keep their data layouts and calling conventions; the shims
+translate in/out of the framework's tiled storage.
+"""
+
+from . import lapack, scalapack  # noqa: F401
+from .scalapack import descinit, from_scalapack, numroc, to_scalapack  # noqa: F401

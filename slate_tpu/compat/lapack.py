@@ -1,0 +1,109 @@
+"""LAPACK-style shims: column-major arrays in, arrays out, single grid.
+
+Analog of the reference's lapack_api tier (ref: lapack_api/lapack_slate.hh
+slate_dgesv / slate_dposv / ... — LAPACK calling conventions routed to
+1-rank SLATE).  Here each shim takes plain numpy/jax arrays, runs the
+framework drivers on a 1x1 grid with a heuristic tile size, and returns
+plain arrays — the path a legacy LAPACK caller migrates through first.
+
+Naming follows LAPACK with the precision prefix dropped (precision comes
+from the input dtype, as in modern LAPACK wrappers)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.matrix import HermitianMatrix, Matrix
+from ..types import Uplo
+
+
+def _nb(n: int) -> int:
+    return max(8, min(256, 1 << max(3, (n // 4).bit_length())))
+
+
+def _mat(a, nb=None) -> Matrix:
+    a = np.asarray(a)
+    nb = nb or _nb(max(a.shape))
+    return Matrix.from_numpy(a, min(nb, a.shape[0]), min(nb, a.shape[1]))
+
+
+def gesv(a, b):
+    """Solve A X = B (LAPACK dgesv).  Returns (x, perm)."""
+    from ..drivers.lu import gesv as _gesv
+    F, X = _gesv(_mat(a), _mat(b))
+    return np.asarray(X.to_numpy()), np.asarray(F.perm)
+
+
+def getrf(a):
+    """LU factor (LAPACK dgetrf).  Returns (lu, perm) with A[perm] = L U."""
+    from ..drivers.lu import getrf as _getrf
+    F = _getrf(_mat(a))
+    return np.asarray(F.LU.to_numpy()), np.asarray(F.perm)
+
+
+def posv(a, b, uplo: str = "L"):
+    """Solve A X = B, A Hermitian positive definite (LAPACK dposv).
+    Returns x."""
+    from ..drivers.cholesky import posv as _posv
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+                                   uplo=Uplo.Lower if uplo.upper() == "L"
+                                   else Uplo.Upper)
+    _, X = _posv(A, _mat(b))
+    return np.asarray(X.to_numpy())
+
+
+def potrf(a, uplo: str = "L"):
+    """Cholesky factor (LAPACK dpotrf).  Returns the triangular factor."""
+    from ..drivers.cholesky import potrf as _potrf
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+                                   uplo=Uplo.Lower if uplo.upper() == "L"
+                                   else Uplo.Upper)
+    return np.asarray(_potrf(A).to_numpy())
+
+
+def gels(a, b):
+    """Least squares min ||A X - B|| (LAPACK dgels).  Returns x."""
+    from ..drivers.qr import gels as _gels
+    return np.asarray(_gels(_mat(a), _mat(b)).to_numpy())
+
+
+def geqrf(a):
+    """QR factor (LAPACK dgeqrf).  Returns the packed QR Matrix factors."""
+    from ..drivers.qr import geqrf as _geqrf
+    return _geqrf(_mat(a))
+
+
+def heev(a, uplo: str = "L"):
+    """Hermitian eigendecomposition (LAPACK dsyev/zheev).
+    Returns (eigenvalues, eigenvectors)."""
+    from ..drivers.heev import heev as _heev
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+                                   uplo=Uplo.Lower if uplo.upper() == "L"
+                                   else Uplo.Upper)
+    lam, Z = _heev(A)
+    return np.asarray(lam), np.asarray(Z.to_numpy())
+
+
+def gesvd(a):
+    """SVD (LAPACK dgesvd).  Returns (u, s, vh)."""
+    from ..drivers.svd import svd as _svd
+    s, U, V = _svd(_mat(a))
+    return (np.asarray(U.to_numpy()), np.asarray(s),
+            np.conj(np.asarray(V.to_numpy())).T)
+
+
+def gesvd_vals(a):
+    """Singular values only."""
+    from ..drivers.svd import svd_vals as _svd_vals
+    return np.asarray(_svd_vals(_mat(a)))
+
+
+def gecon(a):
+    """Reciprocal 1-norm condition estimate via the Higham/Hager
+    estimator (LAPACK dgecon analog)."""
+    from ..drivers.auxiliary import norm as _norm
+    from ..drivers.condest import gecondest
+    from ..drivers.lu import getrf as _getrf
+    from ..types import Norm
+    A = _mat(a)
+    return float(gecondest(_getrf(A), _norm(Norm.One, A)))

@@ -1,0 +1,133 @@
+"""ScaLAPACK descriptor import/export.
+
+Analog of the reference's ScaLAPACK API tier (ref:
+scalapack_api/scalapack_slate.hh slate_scalapack_submatrix /
+fromScaLAPACK wrapping, scalapack_api/scalapack_gemm.cc:14-38): a legacy
+application owns per-process local arrays in ScaLAPACK's 2D block-cyclic
+column-major layout, described by the classic 9-integer array descriptor
+
+    DESC = [DTYPE, CTXT, M, N, MB, NB, RSRC, CSRC, LLD]
+
+This module converts between that world and ``TileStorage``:
+
+- ``from_scalapack(desc, locals_, grid)`` assembles the per-process local
+  arrays into a tiled Matrix (the analog of ``fromScaLAPACK`` views —
+  here a copy, since TPU HBM tiles are one sharded array, not pointers
+  into user memory),
+- ``to_scalapack(A)`` produces the descriptor + per-process local arrays
+  (exactly numroc-sized, column-major), making it a portable checkpoint/
+  interchange format: a real ScaLAPACK program could consume the output.
+
+Only RSRC = CSRC = 0 is supported (the reference's wrappers assert the
+same before wrapping, scalapack_api/scalapack_slate.hh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.grid import Grid
+from ..core.storage import TileStorage
+from ..exceptions import slate_error
+
+DTYPE_DENSE = 1  # ScaLAPACK descriptor DTYPE_ for dense matrices
+
+
+def numroc(n: int, nb: int, iproc: int, isrc: int, nprocs: int) -> int:
+    """NUMber of Rows Or Columns owned locally — the classic ScaLAPACK
+    TOOLS routine (same contract as scalapack's numroc.f)."""
+    mydist = (nprocs + iproc - isrc) % nprocs
+    nblocks = n // nb
+    num = (nblocks // nprocs) * nb
+    extrablocks = nblocks % nprocs
+    if mydist < extrablocks:
+        num += nb
+    elif mydist == extrablocks:
+        num += n % nb
+    return num
+
+
+def descinit(m: int, n: int, mb: int, nb: int, grid: Grid,
+             rsrc: int = 0, csrc: int = 0, ctxt: int = 0) -> tuple:
+    """Build the 9-integer array descriptor (scalapack descinit.f).
+    LLD is the max over the grid column's local row counts, as a
+    single-descriptor program would allocate."""
+    slate_error(rsrc == 0 and csrc == 0,
+                "descinit: only RSRC=CSRC=0 supported")
+    lld = max(1, max(numroc(m, mb, pr, rsrc, grid.p)
+                     for pr in range(grid.p)))
+    return (DTYPE_DENSE, ctxt, m, n, mb, nb, rsrc, csrc, lld)
+
+
+def _check_desc(desc) -> tuple:
+    slate_error(len(desc) == 9, "descriptor must have 9 entries")
+    dtype_, _, m, n, mb, nb, rsrc, csrc, lld = (int(x) for x in desc)
+    slate_error(dtype_ == DTYPE_DENSE, "only dense (DTYPE=1) descriptors")
+    slate_error(rsrc == 0 and csrc == 0, "only RSRC=CSRC=0 supported")
+    return m, n, mb, nb, lld
+
+
+def from_scalapack(desc, locals_, grid: Grid | None = None):
+    """Assemble per-process local arrays into a tiled Matrix.
+
+    ``locals_``: mapping {(pr, pc): 2D array} or nested list
+    ``locals_[pr][pc]`` of the exactly numroc-sized column-major local
+    pieces (Fortran or C memory order both accepted — shape is what
+    matters).  Returns a ``Matrix`` with tile sizes (MB, NB) on ``grid``.
+    """
+    from ..core.matrix import Matrix
+    grid = grid or Grid(1, 1)
+    m, n, mb, nb, _ = _check_desc(desc)
+    p, q = grid.p, grid.q
+
+    def loc(pr, pc):
+        piece = (locals_[(pr, pc)] if isinstance(locals_, dict)
+                 else locals_[pr][pc])
+        return np.asarray(piece)
+
+    dense = np.zeros((m, n), loc(0, 0).dtype)
+    for pr in range(p):
+        for pc in range(q):
+            piece = loc(pr, pc)
+            ml = numroc(m, mb, pr, 0, p)
+            nl = numroc(n, nb, pc, 0, q)
+            slate_error(piece.shape == (ml, nl),
+                        f"local ({pr},{pc}) shape {piece.shape} != "
+                        f"numroc ({ml},{nl})")
+            # local block row lb covers global rows of block ib = lb*p + pr
+            for lb in range(-(-ml // mb) if mb else 0):
+                gi = (lb * p + pr) * mb
+                h = min(mb, m - gi, ml - lb * mb)
+                for lc in range(-(-nl // nb) if nb else 0):
+                    gj = (lc * q + pc) * nb
+                    w = min(nb, n - gj, nl - lc * nb)
+                    dense[gi:gi + h, gj:gj + w] = \
+                        piece[lb * mb:lb * mb + h, lc * nb:lc * nb + w]
+    return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
+
+
+def to_scalapack(A):
+    """Export a Matrix to (desc, {(pr, pc): local array}) in ScaLAPACK
+    layout on A's grid.  Local arrays are Fortran-ordered (column-major),
+    as a ScaLAPACK program would hold them."""
+    grid = A.grid
+    m, n, mb, nb = A.m, A.n, A.mb, A.nb
+    desc = descinit(m, n, mb, nb, grid)
+    dense = np.asarray(A.to_dense())
+    p, q = grid.p, grid.q
+    out = {}
+    for pr in range(p):
+        for pc in range(q):
+            ml = numroc(m, mb, pr, 0, p)
+            nl = numroc(n, nb, pc, 0, q)
+            piece = np.zeros((ml, nl), dense.dtype, order="F")
+            for lb in range(-(-ml // mb) if mb else 0):
+                gi = (lb * p + pr) * mb
+                h = min(mb, m - gi, ml - lb * mb)
+                for lc in range(-(-nl // nb) if nb else 0):
+                    gj = (lc * q + pc) * nb
+                    w = min(nb, n - gj, nl - lc * nb)
+                    piece[lb * mb:lb * mb + h, lc * nb:lc * nb + w] = \
+                        dense[gi:gi + h, gj:gj + w]
+            out[(pr, pc)] = piece
+    return desc, out

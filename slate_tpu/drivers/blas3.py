@@ -69,14 +69,20 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
         # All operands are normalised onto C's grid (redistributing if they
         # live elsewhere — the analog of the reference's requirement that all
         # three matrices share one MPI communicator).
-        del method  # gemmA mesh variant not yet distinct: see gemmA().
         Cn = as_root_general(C, grid=C.grid)
         An = as_root_general(A, Cn.storage.mb, None, grid=C.grid)
         Bn = as_root_general(B, An.storage.nb, Cn.storage.nb, grid=C.grid)
         slate_error(An.storage.Nt == Bn.storage.Mt, "gemm: k tiling differs")
-        data = summa.summa_gemm_data(
-            An.storage.data, Bn.storage.data, Cn.storage.data,
-            alpha, beta, An.storage.Nt, Cn.grid)
+        if method is MethodGemm.gemmA:
+            # stationary-A, replicate-B + reduce-over-C (ref: gemmA.cc)
+            from ..parallel.gemm_a import dist_gemmA_data
+            data = dist_gemmA_data(
+                An.storage.data, Bn.storage.data, Cn.storage.data,
+                alpha, beta, An.storage.Nt, Cn.grid)
+        else:
+            data = summa.summa_gemm_data(
+                An.storage.data, Bn.storage.data, Cn.storage.data,
+                alpha, beta, An.storage.Nt, Cn.grid)
         return _result_mat(Cn, data)
 
     # single target: one fused MXU contraction
@@ -163,11 +169,39 @@ def _root_storage_triangular(A, grid=None):
 
 def trmm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     """B = alpha op(A) B (Left) or alpha B op(A) (Right), A triangular
-    (ref: src/trmm.cc -> work/work_trmm.cc)."""
+    (ref: src/trmm.cc -> work/work_trmm.cc).
+
+    mesh: triangle-aware packed-pair kernel over A's STORED tiles only —
+    half a gemm's flops, no dense expansion (parallel/dist_herk.py
+    dist_trmm_data).  Transposed-A views fall back to the dense path."""
     sd = _side(side)
+    if (resolve_target(opts, B) is Target.mesh and B.grid.mesh is not None
+            and A.op is Op.NoTrans and A.is_root_view()
+            and A.storage.mb == A.storage.nb
+            # the kernel reads A.storage raw, so its cyclic layout must be
+            # B's grid's; cross-grid operands fall back to the dense path
+            and A.grid is B.grid):
+        from ..parallel.dist_herk import (dist_trmm_data,
+                                          dist_trmm_right_data)
+        lower = A.uplo is Uplo.Lower
+        unit = A.diag is Diag.Unit
+        nb = A.storage.nb
+        An = Matrix(A.storage)
+        if sd is Side.Left:
+            Bn = as_root_general(B, nb, None, grid=B.grid)
+            data = dist_trmm_data(
+                An.storage.data, Bn.storage.data, alpha,
+                Kt=An.storage.Nt, Mt=An.storage.Mt, grid=B.grid,
+                lower=lower, unit_diag=unit, n=An.storage.n)
+        else:
+            Bn = as_root_general(B, None, nb, grid=B.grid)
+            data = dist_trmm_right_data(
+                An.storage.data, Bn.storage.data, alpha,
+                Kt=An.storage.Mt, Nt=An.storage.Nt, grid=B.grid,
+                lower=lower, unit_diag=unit, n=An.storage.n)
+        return _result_mat(Bn, data)
     ad = A.to_dense()                      # expands triangle incl. unit diag
     if resolve_target(opts, B) is Target.mesh and B.grid.mesh is not None:
-        # ride the SUMMA path for the multiply
         Ag = Matrix(TileStorage.from_dense(ad, A.mb, A.nb, B.grid))
         return gemm(alpha, Ag, B, 0.0, None, opts) if sd is Side.Left \
             else gemm(alpha, B, Ag, 0.0, None, opts)
@@ -178,15 +212,42 @@ def trmm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
 
 # ---------------------------------------------------------------- rank-k
 
+def _rank_k_mesh(alpha, A, beta, C, opts, conj: bool, B=None, alpha2=None):
+    """Shared mesh fast path for herk/syrk/her2k/syr2k: triangle-aware
+    packed-pair kernel over C's STORED triangle tiles — half a full gemm's
+    flops and comm (ref: internal_herk.cc diagonal herk + off-diag gemm).
+    Returns the updated general storage Matrix, or None when the operands
+    don't qualify (caller falls back to the gemm composition)."""
+    from ..parallel.dist_herk import dist_herk_data
+    if not (resolve_target(opts, C) is Target.mesh
+            and C.grid.mesh is not None and C.op is Op.NoTrans
+            and C.is_root_view() and C.storage.mb == C.storage.nb):
+        return None
+    nb = C.storage.nb
+    An = as_root_general(A, nb, None, grid=C.grid)
+    b_data = None
+    if B is not None:
+        Bn = as_root_general(B, nb, An.storage.nb, grid=C.grid)
+        slate_error(Bn.storage.Nt == An.storage.Nt, "rank-2k: k tiling")
+        b_data = Bn.storage.data
+    cs = C.storage
+    data = dist_herk_data(
+        An.storage.data, cs.data, alpha, beta, Kt=An.storage.Nt,
+        Mt=cs.Mt, Nt=cs.Nt, grid=C.grid, lower=C.uplo is Uplo.Lower,
+        conj=conj, b_data=b_data, alpha2=alpha2)
+    return _result_mat(C, data)
+
+
 def herk(alpha, A, beta, C, opts: Options | None = None):
     """C = alpha A A^H + beta C, C Hermitian (ref: src/herk.cc,
-    internal_herk.cc:843).  mesh rides the SUMMA gemm on (A, A^H)."""
+    internal_herk.cc:843).  mesh: triangle-aware, half-gemm cost."""
     from ..core.matrix import BaseTrapezoidMatrix, HermitianMatrix
     slate_error(isinstance(C, BaseTrapezoidMatrix),
                 "herk: C must be Hermitian/Symmetric")
     slate_error(A.m == C.m, "herk: dims")
-    out = gemm(alpha, A, A.conj_transpose(), beta,
-               _general_of(C), opts)
+    out = _rank_k_mesh(alpha, A, beta, C, opts, conj=True)
+    if out is None:
+        out = gemm(alpha, A, A.conj_transpose(), beta, _general_of(C), opts)
     return HermitianMatrix._from_view(out, C._uplo_logical())
 
 
@@ -195,20 +256,25 @@ def syrk(alpha, A, beta, C, opts: Options | None = None):
     from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
     slate_error(isinstance(C, BaseTrapezoidMatrix),
                 "syrk: C must be Symmetric")
-    out = gemm(alpha, A, A.transpose(), beta, _general_of(C), opts)
+    out = _rank_k_mesh(alpha, A, beta, C, opts, conj=False)
+    if out is None:
+        out = gemm(alpha, A, A.transpose(), beta, _general_of(C), opts)
     return SymmetricMatrix._from_view(out, C._uplo_logical())
 
 
 def her2k(alpha, A, B, beta, C, opts: Options | None = None):
     """C = alpha A B^H + conj(alpha) B A^H + beta C (ref: src/her2k.cc,
-    internal_her2k.cc:1062)."""
+    internal_her2k.cc:1062).  mesh: one triangle-aware pass."""
     from ..core.matrix import BaseTrapezoidMatrix, HermitianMatrix
     slate_error(isinstance(C, BaseTrapezoidMatrix),
                 "her2k: C must be Hermitian")
-    t1 = gemm(alpha, A, B.conj_transpose(), beta, _general_of(C), opts)
-    t2 = gemm(jnp.conj(jnp.asarray(alpha)), B, A.conj_transpose(), 1.0,
-              t1, opts)
-    return HermitianMatrix._from_view(t2, C._uplo_logical())
+    out = _rank_k_mesh(alpha, A, beta, C, opts, conj=True, B=B,
+                       alpha2=jnp.conj(jnp.asarray(alpha)))
+    if out is None:
+        t1 = gemm(alpha, A, B.conj_transpose(), beta, _general_of(C), opts)
+        out = gemm(jnp.conj(jnp.asarray(alpha)), B, A.conj_transpose(), 1.0,
+                   t1, opts)
+    return HermitianMatrix._from_view(out, C._uplo_logical())
 
 
 def syr2k(alpha, A, B, beta, C, opts: Options | None = None):
@@ -216,9 +282,12 @@ def syr2k(alpha, A, B, beta, C, opts: Options | None = None):
     from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
     slate_error(isinstance(C, BaseTrapezoidMatrix),
                 "syr2k: C must be Symmetric")
-    t1 = gemm(alpha, A, B.transpose(), beta, _general_of(C), opts)
-    t2 = gemm(alpha, B, A.transpose(), 1.0, t1, opts)
-    return SymmetricMatrix._from_view(t2, C._uplo_logical())
+    out = _rank_k_mesh(alpha, A, beta, C, opts, conj=False, B=B,
+                       alpha2=alpha)
+    if out is None:
+        t1 = gemm(alpha, A, B.transpose(), beta, _general_of(C), opts)
+        out = gemm(alpha, B, A.transpose(), 1.0, t1, opts)
+    return SymmetricMatrix._from_view(out, C._uplo_logical())
 
 
 def hemm(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
@@ -237,9 +306,15 @@ def symm(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
 
 
 def hemmA(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
-    """Stationary-A hemm (ref: src/hemmA.cc); alias of hemm pending a
-    distinct reduce-over-C mesh pattern."""
-    return hemm(side, alpha, A, B, beta, C, opts)
+    """Stationary-A hemm (ref: src/hemmA.cc): the expanded Hermitian A
+    stays put while skinny B is replicated and C is reduce-scattered to
+    its owners — gemmA's comm pattern (parallel/gemm_a.py).  Side.Right
+    swaps the operands into gemm's replicated slot, which would replicate
+    the LARGE Hermitian matrix, so only Side.Left forces gemmA."""
+    o = dict(opts or {})
+    if _side(side) is Side.Left:
+        o[Option.MethodGemm] = MethodGemm.gemmA
+    return hemm(side, alpha, A, B, beta, C, o)
 
 
 def _general_of(C) -> Matrix:
@@ -248,10 +323,10 @@ def _general_of(C) -> Matrix:
 
 
 def gemmA(alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
-    """Stationary-A gemm (ref: src/gemmA.cc).  NOTE: on mesh the
-    reduce-over-C-owners communication pattern is not yet distinct — this is
-    currently an alias of the stationary-C path (correct, not comm-optimal
-    for single-block-column C)."""
+    """Stationary-A gemm (ref: src/gemmA.cc): A never moves; skinny B is
+    replicated and partial C is psum_scattered to its owners
+    (parallel/gemm_a.py).  Auto-selected for single-block-column C
+    (method.hh:87-98); force with Option.MethodGemm."""
     o = dict(opts or {})
     o[Option.MethodGemm] = MethodGemm.gemmA
     return gemm(alpha, A, B, beta, C, o)

@@ -210,9 +210,11 @@ def _gmres_ir(A: Matrix, B: Matrix, solve_lo, opts: Options | None,
             beta.astype(dt))
         Q, R = jnp.linalg.qr(Hc)                           # reduced QR
         qb = jnp.einsum("nij,ni->nj", jnp.conj(Q), rhs)    # [nrhs, m]
-        # guard exactly-singular R (breakdown columns): unit diagonal
+        # guard (near-)singular R (breakdown / nearly-converged columns):
+        # a relative threshold, so subnormal diagonals can't divide to Inf
         diag = jnp.abs(jnp.diagonal(R, axis1=-2, axis2=-1))
-        shift = jnp.where(diag > 0, 0.0, 1.0).astype(dt)
+        floor = eps(dt) * jnp.max(diag, axis=-1, keepdims=True)
+        shift = jnp.where(diag > floor, 0.0, 1.0).astype(dt)
         R = R + shift[..., None] * jnp.eye(restart, dtype=dt)[None]
         y = jax.scipy.linalg.solve_triangular(R, qb[..., None],
                                               lower=False)[..., 0]

@@ -1,0 +1,305 @@
+"""Triangle-aware distributed rank-k / rank-2k updates and trmm.
+
+Analog of the reference's internal_herk.cc:1-843 / internal_her2k.cc /
+internal_syrk.cc / internal_trmm.cc: the reference enumerates only the
+STORED triangle's tiles (diagonal tiles get herk, off-diagonal gemm), so a
+rank-k update costs half a gemm's flops and communication.
+
+TPU-first shape: static shapes everywhere, so "skip the other triangle"
+becomes a *packed pair list*.  For each rank the set of its local tiles
+that fall in the stored triangle is computed as a (statically-sized,
+dynamically-indexed) list of (row, col) tile pairs — the pair count varies
+by ±1 across ranks, so every rank pads to the mesh-wide max S and masks.
+The update is then ONE batched einsum over S tile pairs per k step —
+half the flops of the full [mtl x ntl] outer product, still MXU-batched.
+
+Communication per step k matches dist_chol's herk trailing pattern: the
+panel tile-column is broadcast along q (row owners) and all-gathered along
+p (column owners) — the reference's symmetric listBcast of the panel to
+both row and column communicators (src/potrf.cc:232-242).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.collectives import bcast_from_col
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+
+
+def _pair_budget(Mt: int, Nt: int, p: int, q: int, mtl: int, ntl: int,
+                 lower: bool) -> int:
+    """Max over ranks of #local tiles in the stored triangle (static)."""
+    best = 1
+    for r in range(p):
+        for c in range(q):
+            gi = r + p * np.arange(mtl)
+            gj = c + q * np.arange(ntl)
+            m = (gi[:, None] >= gj[None, :]) if lower else \
+                (gi[:, None] <= gj[None, :])
+            m &= (gi[:, None] < Mt) & (gj[None, :] < Nt)
+            best = max(best, int(m.sum()))
+    return best
+
+
+def _local_pairs(r, c, p, q, mtl, ntl, Mt, Nt, S, lower: bool):
+    """Packed (il, jl) lists of this rank's triangle tiles + validity."""
+    gi = r + p * jnp.arange(mtl)
+    gj = c + q * jnp.arange(ntl)
+    cmp = (gi[:, None] >= gj[None, :]) if lower else \
+        (gi[:, None] <= gj[None, :])
+    mask = cmp & (gi[:, None] < Mt) & (gj[None, :] < Nt)
+    flat = mask.reshape(-1).astype(jnp.int32)
+    _, idx = lax.top_k(flat, S)                  # distinct flat positions
+    valid = jnp.take(flat, idx).astype(bool)
+    return idx // ntl, idx % ntl, idx, valid, mask
+
+
+def _gather_panel_rows(pan, gj, p):
+    """All panel tiles along the p axis, then pick rows gj (the tiles the
+    column owners need): pan [mtl, nb, kb] -> [ntl, nb, kb]."""
+    allpan = lax.all_gather(pan, AXIS_P)         # [p, mtl, nb, kb]
+    return allpan[gj % p, gj // p]
+
+
+def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
+                   grid: Grid, lower: bool, conj: bool,
+                   b_data=None, alpha2=None):
+    """C_tri = alpha A op(A) + beta C_tri on the stored triangle's tiles.
+
+    a_data: A in cyclic storage [p*mtl, q*ktl, nb, kb]
+    c_data: C cyclic [p*mtl, q*ntl, nb, nb] (square tiles)
+    b_data: if given, rank-2k: C += alpha A op(B) + alpha2 B op(A)
+    op is conj-transpose (conj=True, herk/her2k) or transpose (syrk/syr2k).
+    Tiles outside the stored triangle are returned UNCHANGED (they are
+    never read through the Hermitian/symmetric wrappers).
+    """
+    p, q = grid.p, grid.q
+    mtl = a_data.shape[0] // p
+    ntl = c_data.shape[1] // q
+    S = _pair_budget(Mt, Nt, p, q, mtl, ntl, lower)
+    two_k = b_data is not None
+    a2 = alpha2 if alpha2 is not None else alpha
+
+    def local(a_loc, c_loc, *maybe_b):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        gj = c + q * jnp.arange(ntl)
+        il, jl, idx, valid, mask = _local_pairs(
+            r, c, p, q, mtl, ntl, Mt, Nt, S, lower)
+        dt = c_loc.dtype
+        nb = c_loc.shape[-1]
+
+        def panel(k, data):
+            pan = lax.dynamic_index_in_dim(data, k // q, axis=1,
+                                           keepdims=False)
+            pan = bcast_from_col(pan, k % q)     # [mtl, nb, kb] my rows
+            cols = _gather_panel_rows(pan, gj, p)  # [ntl, nb, kb] my cols
+            return pan, cols
+
+        def pair_update(rows, cols):
+            rg = jnp.take(rows, il, axis=0)      # [S, nb, kb]
+            cg = jnp.take(cols, jl, axis=0)      # [S, nb, kb]
+            cg = jnp.conj(cg) if conj else cg
+            return jnp.einsum("sab,scb->sac", rg, cg,
+                              preferred_element_type=dt)
+
+        def body(k, acc):
+            arow, acol = panel(k, a_loc)
+            if two_k:
+                brow, bcol = panel(k, maybe_b[0])
+                upd = (jnp.asarray(alpha, dt) * pair_update(arow, bcol) +
+                       jnp.asarray(a2, dt) * pair_update(brow, acol))
+            else:
+                upd = jnp.asarray(alpha, dt) * pair_update(arow, acol)
+            return acc + upd
+
+        acc0 = lax.pcast(jnp.zeros((S, nb, nb), dt), (AXIS_P, AXIS_Q),
+                         to="varying")
+        acc = lax.fori_loop(0, Kt, body, acc0)
+        cflat = c_loc.reshape(mtl * ntl, nb, nb)
+        # beta applies to the stored triangle only; other tiles unchanged
+        tri = mask.reshape(-1)
+        cflat = jnp.where(tri[:, None, None], jnp.asarray(beta, dt) * cflat,
+                          cflat)
+        cflat = cflat.at[idx].add(
+            jnp.where(valid[:, None, None], acc, jnp.zeros_like(acc)))
+        return cflat.reshape(mtl, ntl, nb, nb)
+
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    args = (a_data, c_data) + ((b_data,) if two_k else ())
+    fn = jax.shard_map(local, mesh=grid.mesh,
+                       in_specs=(spec,) * len(args), out_specs=spec)
+    return fn(*args)
+
+
+def _tri_mask_tile(tile, on_diag, before_diag, lower: bool,
+                   unit_diag: bool):
+    """Mask one batch of A tiles to the stored triangle: full inside the
+    triangle, tri-masked on the diagonal, zero outside.  ``before_diag``
+    = this tile is on the triangle's full side."""
+    dt = tile.dtype
+    nb = tile.shape[-1]
+    ii = jnp.arange(nb)
+    tri = (ii[:, None] >= ii[None, :]) if lower else \
+        (ii[:, None] <= ii[None, :])
+    eye = jnp.eye(nb, dtype=dt)
+    out = jnp.where(on_diag[:, None, None], tile * tri[None], tile)
+    if unit_diag:
+        out = jnp.where(on_diag[:, None, None], out * (1 - eye) + eye, out)
+    keep = on_diag | before_diag
+    return jnp.where(keep[:, None, None], out, jnp.zeros_like(out))
+
+
+def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
+                   lower: bool, unit_diag: bool, n: int,
+                   sb: int | None = None):
+    """B = alpha A B with A triangular, stored triangle only (ref:
+    src/trmm.cc -> work::trmm).  SUMMA k loop with STATIC shrinking row
+    windows (the dist_chol superblock discipline): step k multiplies A's
+    masked tile column k against B's broadcast tile row k and accumulates
+    into only the rows the triangle can touch — half a gemm's flops, no
+    dense expansion, diagonal tiles masked on the fly so junk in A's
+    unstored half never leaks in.
+
+    a_data: A cyclic [p*mtl, q*ktl, nb, nb]; b_data [p*mtl, q*ntl, nb, cb].
+    """
+    from .dist_chol import superblock
+    p, q = grid.p, grid.q
+    mtl = a_data.shape[0] // p
+    ntl = b_data.shape[1] // q
+    nb = a_data.shape[-1]
+    sb = sb if sb is not None else superblock(Kt)
+
+    def local(a_loc, b_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = b_loc.dtype
+        cb = b_loc.shape[-1]
+        gi_all = r + p * jnp.arange(mtl)
+        zi = jnp.zeros((), jnp.int32)
+        acc = lax.pcast(jnp.zeros((mtl, ntl, nb, cb), dt),
+                        (AXIS_P, AXIS_Q), to="varying")
+
+        def panel_k(k, a_loc, b_loc):
+            # A tile column k -> all mesh columns (listBcast of the panel)
+            pan = lax.dynamic_index_in_dim(a_loc, k // q, axis=1,
+                                           keepdims=False)
+            pan = bcast_from_col(pan, k % q)     # [mtl, nb, nb] my rows
+            pan = _tri_mask_tile(
+                pan, gi_all == k,
+                (gi_all > k) if lower else (gi_all < k), lower, unit_diag)
+            # B tile row k -> all mesh rows
+            row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0,
+                                           keepdims=False)
+            me = lax.axis_index(AXIS_P)
+            row = jnp.where(me == k % p, row, jnp.zeros_like(row))
+            row = lax.psum(row, AXIS_P)          # [ntl, nb, cb]
+            return pan, row
+
+        for k0 in range(0, Kt, sb):
+            k1 = min(k0 + sb, Kt)
+            if lower:
+                S = mtl - (k0 // p)              # rows gi >= k0
+            else:
+                S = min(mtl, -(-k1 // p))        # rows gi <= k1-1
+
+            def super_step(k, acc, S=S, k0=k0):
+                pan, row = panel_k(k, a_loc, b_loc)
+                if lower:
+                    sr = jnp.clip(-(-(k0 - r) // p), 0,
+                                  mtl - S).astype(jnp.int32)
+                else:
+                    sr = zi
+                pwin = lax.dynamic_slice(pan, (sr, zi, zi), (S, nb, nb))
+                upd = jnp.einsum("iab,jbc->ijac", pwin, row,
+                                 preferred_element_type=dt)
+                cur = lax.dynamic_slice(acc, (sr, zi, zi, zi),
+                                        (S, ntl, nb, cb))
+                return lax.dynamic_update_slice(acc, cur + upd,
+                                                (sr, zi, zi, zi))
+
+            if S > 0:
+                acc = lax.fori_loop(k0, k1, super_step, acc)
+        return jnp.asarray(alpha, dt) * acc
+
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, spec),
+                       out_specs=spec)
+    return fn(a_data, b_data)
+
+
+def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
+                         grid: Grid, lower: bool, unit_diag: bool, n: int,
+                         sb: int | None = None):
+    """B = alpha B A with A triangular: the mirror of the left kernel —
+    k runs over A's tile ROWS, B's tile column k is broadcast along q,
+    and the static window covers the columns the triangle can touch."""
+    from .dist_chol import superblock
+    p, q = grid.p, grid.q
+    ntl = a_data.shape[1] // q
+    mtl = b_data.shape[0] // p
+    nb = a_data.shape[-1]
+    sb = sb if sb is not None else superblock(Kt)
+
+    def local(a_loc, b_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = b_loc.dtype
+        cb = b_loc.shape[-2]
+        gj_all = c + q * jnp.arange(ntl)
+        zi = jnp.zeros((), jnp.int32)
+        acc = lax.pcast(jnp.zeros((mtl, ntl, cb, nb), dt),
+                        (AXIS_P, AXIS_Q), to="varying")
+
+        def panel_k(k, a_loc, b_loc):
+            # A tile row k -> all mesh rows
+            arow = lax.dynamic_index_in_dim(a_loc, k // p, axis=0,
+                                            keepdims=False)
+            me = lax.axis_index(AXIS_P)
+            arow = jnp.where(me == k % p, arow, jnp.zeros_like(arow))
+            arow = lax.psum(arow, AXIS_P)        # [ntl, nb, nb] my cols
+            # A[k, j] is full for j < k (lower) / j > k (upper)
+            arow = _tri_mask_tile(
+                arow, gj_all == k,
+                (gj_all < k) if lower else (gj_all > k), lower, unit_diag)
+            # B tile column k -> all mesh columns
+            bcol = lax.dynamic_index_in_dim(b_loc, k // q, axis=1,
+                                            keepdims=False)
+            bcol = bcast_from_col(bcol, k % q)   # [mtl, cb, nb]
+            return arow, bcol
+
+        for k0 in range(0, Kt, sb):
+            k1 = min(k0 + sb, Kt)
+            if lower:
+                T = min(ntl, -(-k1 // q))        # cols gj <= k1-1
+            else:
+                T = ntl - (k0 // q)              # cols gj >= k0
+
+            def super_step(k, acc, T=T, k0=k0):
+                arow, bcol = panel_k(k, a_loc, b_loc)
+                if lower:
+                    sc = zi
+                else:
+                    sc = jnp.clip(-(-(k0 - c) // q), 0,
+                                  ntl - T).astype(jnp.int32)
+                awin = lax.dynamic_slice(arow, (sc, zi, zi), (T, nb, nb))
+                upd = jnp.einsum("iab,jbc->ijac", bcol, awin,
+                                 preferred_element_type=dt)
+                cur = lax.dynamic_slice(acc, (zi, sc, zi, zi),
+                                        (mtl, T, cb, nb))
+                return lax.dynamic_update_slice(acc, cur + upd,
+                                                (zi, sc, zi, zi))
+
+            if T > 0:
+                acc = lax.fori_loop(k0, k1, super_step, acc)
+        return jnp.asarray(alpha, dt) * acc
+
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, spec),
+                       out_specs=spec)
+    return fn(a_data, b_data)

@@ -1,0 +1,118 @@
+"""Simplified-API tests: verb names dispatch to the right driver per
+structure (analog of ref include/slate/simplified_api.hh overload set)."""
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import api
+
+
+def test_multiply_dispatch(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, n))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    np.testing.assert_allclose(api.multiply(1.0, A, B).to_numpy(), a @ b,
+                               atol=1e-12)
+    # Hermitian A -> hemm (expanded triangle)
+    H = st.HermitianMatrix.from_numpy(a, nb)
+    hd = np.tril(a) + np.tril(a, -1).T
+    np.testing.assert_allclose(api.multiply(1.0, H, B).to_numpy(), hd @ b,
+                               atol=1e-12)
+    # Hermitian B -> right-side hemm
+    np.testing.assert_allclose(api.multiply(1.0, B, H).to_numpy(), b @ hd,
+                               atol=1e-12)
+    # band A -> gbmm
+    kl = ku = 2
+    band = np.triu(np.tril(a, kl), -ku).T * 0 + np.triu(np.tril(a, kl), -ku)
+    Ab = st.BandMatrix.from_numpy(band, kl, ku, nb)
+    np.testing.assert_allclose(api.multiply(1.0, Ab, B).to_numpy(),
+                               band @ b, atol=1e-12)
+
+
+def test_triangular_verbs(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    L = st.TriangularMatrix.from_numpy(a, nb, uplo=st.Uplo.Lower)
+    B = st.Matrix.from_numpy(b, nb)
+    ld = np.tril(a)
+    X = api.triangular_solve(1.0, L, B)
+    np.testing.assert_allclose(ld @ X.to_numpy(), b, atol=1e-10)
+    Y = api.triangular_multiply(1.0, L, B)
+    np.testing.assert_allclose(Y.to_numpy(), ld @ b, atol=1e-12)
+    # triangular operand second -> right side
+    C = st.Matrix.from_numpy(b.T, nb)
+    Z = api.triangular_multiply(1.0, C, L)
+    np.testing.assert_allclose(Z.to_numpy(), b.T @ ld, atol=1e-12)
+
+
+def test_rank_k_updates(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, n))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    C = st.HermitianMatrix.from_numpy(np.zeros((n, n)), nb)
+    np.testing.assert_allclose(
+        api.rank_k_update(1.0, A, 0.0, C).to_numpy(), a @ a.T, atol=1e-12)
+    np.testing.assert_allclose(
+        api.rank_2k_update(1.0, A, B, 0.0, C).to_numpy(),
+        a @ b.T + b @ a.T, atol=1e-12)
+
+
+def test_solve_verbs(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    spd = a @ a.T + n * np.eye(n)
+    sym = (a + a.T) / 2
+    b = rng.standard_normal((n, 2))
+    B = st.Matrix.from_numpy(b, nb)
+
+    x = api.lu_solve(st.Matrix.from_numpy(a, nb), B).to_numpy()
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+
+    x = api.chol_solve(st.HermitianMatrix.from_numpy(spd, nb), B).to_numpy()
+    np.testing.assert_allclose(spd @ x, b, atol=1e-8)
+
+    x = api.indefinite_solve(st.HermitianMatrix.from_numpy(sym, nb),
+                             B).to_numpy()
+    np.testing.assert_allclose(sym @ x, b, atol=1e-8)
+
+    m = 32
+    atall = rng.standard_normal((m, n))
+    btall = rng.standard_normal((m, 2))
+    x = api.least_squares_solve(st.Matrix.from_numpy(atall, nb),
+                                st.Matrix.from_numpy(btall, nb)).to_numpy()
+    x_ref = np.linalg.lstsq(atall, btall, rcond=None)[0]
+    np.testing.assert_allclose(x[:n], x_ref, atol=1e-9)
+
+
+def test_eig_svd_verbs(rng):
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n))
+    sym = (a + a.T) / 2
+    H = st.HermitianMatrix.from_numpy(sym, nb)
+    lam = np.asarray(api.eig_vals(H))
+    np.testing.assert_allclose(lam, np.linalg.eigvalsh(sym), atol=1e-10)
+    s = np.asarray(api.svd_vals(st.Matrix.from_numpy(a, nb)))
+    np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                               atol=1e-10)
+
+
+def test_lapack_shims(rng):
+    from slate_tpu.compat import lapack
+    n = 12
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    x, perm = lapack.gesv(a, b)
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+    spd = a @ a.T
+    np.testing.assert_allclose(spd @ lapack.posv(spd, b), b, atol=1e-8)
+    u, s, vh = lapack.gesvd(a)
+    np.testing.assert_allclose(u[:, :n] * s @ vh[:n], a, atol=1e-9)
+    rc = lapack.gecon(a)
+    assert 0 < rc <= 1

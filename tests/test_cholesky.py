@@ -146,6 +146,7 @@ def test_her2k_symm(rng):
 
 @pytest.mark.parametrize("target,pq", [("single", None), ("mesh", (2, 2)),
                                        ("mesh", (2, 4))])
+@pytest.mark.slow
 def test_posv(rng, target, pq):
     n, nrhs, nb = 24, 8, 4
     g = st.Grid(*pq, devices=jax.devices()[: pq[0] * pq[1]]) if pq else None

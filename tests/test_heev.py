@@ -25,6 +25,7 @@ def test_heev_values(rng, n, nb):
 
 
 @pytest.mark.parametrize("n,nb", [(16, 4), (21, 5)])
+@pytest.mark.slow
 def test_heev_vectors(rng, n, nb):
     a = herm(rng, n)
     A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
@@ -36,6 +37,7 @@ def test_heev_vectors(rng, n, nb):
     np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_heev_complex(rng):
     n, nb = 14, 4
     a = herm(rng, n, np.complex128)
@@ -47,6 +49,7 @@ def test_heev_complex(rng):
     np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_heev_mesh(rng):
     n, nb = 20, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4])

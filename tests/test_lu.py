@@ -63,6 +63,7 @@ def test_gesv_adversarial_single(rng, method):
 
 @pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
 @pytest.mark.parametrize("n,nb", [(24, 4), (22, 5)])
+@pytest.mark.slow
 def test_gesv_mesh(rng, p, q, n, nb):
     g = st.Grid(p, q, devices=jax.devices()[: p * q])
     a = adversarial(rng, n)
@@ -76,6 +77,7 @@ def test_gesv_mesh(rng, p, q, n, nb):
     assert resid < 1e-14
 
 
+@pytest.mark.slow
 def test_getrf_mesh_factors(rng):
     """Mesh factors reproduce A[perm] = L U exactly, pads clean."""
     n, nb, p, q = 18, 4, 2, 2
@@ -92,6 +94,7 @@ def test_getrf_mesh_factors(rng):
     assert np.all(canon[:, -1, :, :, ][..., 2:] == 0)
 
 
+@pytest.mark.slow
 def test_gesv_nopiv_mesh(rng):
     n, nb = 16, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4])
@@ -103,6 +106,7 @@ def test_gesv_nopiv_mesh(rng):
     assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-12
 
 
+@pytest.mark.slow
 def test_gesv_tntpiv_mesh(rng):
     n, nb = 16, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4])
@@ -115,6 +119,7 @@ def test_gesv_tntpiv_mesh(rng):
     assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-11
 
 
+@pytest.mark.slow
 def test_mesh_getrs_mismatched_b_tiling(rng):
     """Mesh getrs fast path with B.mb != LU.nb (B pads differently):
     dist_permute_rows builds perm_pad over B's own padded row space, so

@@ -9,6 +9,7 @@ import slate_tpu as st
 from slate_tpu.util.generator import generate_hermitian, generate_matrix
 
 
+@pytest.mark.slow
 def test_gesv_mixed_reaches_double(rng):
     n, nb = 48, 8
     A = generate_matrix("svd", n, n, nb, seed=1, cond=1e4)

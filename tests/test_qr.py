@@ -61,6 +61,7 @@ def test_geqrf_mesh(rng, p, q_, m, n, nb):
     ("single", "n", "r"), ("single", "c", "r"),
     ("mesh", "c", "l"), ("mesh", "n", "r"),
 ])
+@pytest.mark.slow
 def test_unmqr_orthogonal_apply(rng, target, op, side):
     m, n, nb = 24, 16, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
@@ -78,6 +79,7 @@ def test_unmqr_orthogonal_apply(rng, target, op, side):
 
 
 @pytest.mark.parametrize("target", ["single", "mesh"])
+@pytest.mark.slow
 def test_gels_qr_tall(rng, target):
     m, n, nrhs, nb = 36, 12, 3, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
@@ -91,6 +93,7 @@ def test_gels_qr_tall(rng, target):
 
 
 @pytest.mark.parametrize("target", ["single", "mesh"])
+@pytest.mark.slow
 def test_gels_cholqr_tall(rng, target):
     m, n, nrhs, nb = 48, 8, 3, 4
     g = st.Grid(2, 2, devices=jax.devices()[:4]) if target == "mesh" else None
@@ -113,6 +116,7 @@ def test_gels_auto_dispatch(rng):
     np.testing.assert_allclose(X.to_numpy(), xref, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_gels_minimum_norm(rng):
     m, n, nb = 12, 30, 4
     a = rng.standard_normal((m, n))

@@ -1,0 +1,158 @@
+"""Mesh tests for the triangle-aware rank-k/rank-2k kernels, the packed
+triangle trmm, and stationary-A gemmA (ref: internal_herk.cc,
+internal_her2k.cc, internal_trmm.cc, gemmA.cc)."""
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+
+
+def _grid(p, q):
+    return st.Grid(p, q, devices=jax.devices()[: p * q])
+
+
+@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+@pytest.mark.parametrize("n,k,nb", [(24, 16, 4), (22, 13, 5)])
+def test_herk_mesh(rng, p, q, uplo, n, k, nb):
+    g = _grid(p, q)
+    a = rng.standard_normal((n, k))
+    c = rng.standard_normal((n, n))
+    c = (c + c.T) / 2
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    C = st.HermitianMatrix.from_numpy(c, nb, uplo, g)
+    out = st.herk(1.0, A, 0.5, C)
+    np.testing.assert_allclose(out.to_numpy(), a @ a.T + 0.5 * c,
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_herk_mesh_complex(rng):
+    g = _grid(2, 2)
+    n, k, nb = 16, 12, 4
+    a = rng.standard_normal((n, k)) + 1j * rng.standard_normal((n, k))
+    h = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    h = (h + h.conj().T) / 2
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    C = st.HermitianMatrix.from_numpy(h, nb, st.Uplo.Lower, g)
+    out = st.herk(1.0, A, 1.0, C)
+    np.testing.assert_allclose(out.to_numpy(), a @ a.conj().T + h,
+                               rtol=1e-11, atol=1e-11)
+
+
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_her2k_syr2k_mesh(rng, uplo):
+    g = _grid(2, 2)
+    n, k, nb = 20, 12, 4
+    a = rng.standard_normal((n, k))
+    b = rng.standard_normal((n, k))
+    c = rng.standard_normal((n, n))
+    c = (c + c.T) / 2
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    C = st.HermitianMatrix.from_numpy(c, nb, uplo, g)
+    out = st.her2k(1.0, A, B, 1.0, C)
+    np.testing.assert_allclose(out.to_numpy(), a @ b.T + b @ a.T + c,
+                               rtol=1e-11, atol=1e-11)
+    Cs = st.SymmetricMatrix.from_numpy(c, nb, uplo, g)
+    out2 = st.syr2k(2.0, A, B, 0.0, Cs)
+    np.testing.assert_allclose(out2.to_numpy(), 2 * (a @ b.T + b @ a.T),
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_herk_leaves_other_triangle_untouched(rng):
+    """The packed kernel must only write the stored triangle's tiles."""
+    g = _grid(2, 2)
+    n, k, nb = 16, 8, 4
+    a = rng.standard_normal((n, k))
+    c = rng.standard_normal((n, n))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    C = st.HermitianMatrix.from_numpy(c, nb, st.Uplo.Lower, g)
+    out = st.herk(1.0, A, 0.0, C)
+    dense_store = np.asarray(out.storage.to_dense())   # raw tiles, no expand
+    # strictly-upper TILES (full tiles above the diagonal) kept old junk =
+    # original c values there (beta doesn't touch them)
+    for it in range(n // nb):
+        for jt in range(n // nb):
+            if jt > it:
+                blk = np.s_[it * nb:(it + 1) * nb, jt * nb:(jt + 1) * nb]
+                np.testing.assert_array_equal(dense_store[blk], c[blk])
+
+
+@pytest.mark.parametrize("side", ["l", "r"])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+@pytest.mark.parametrize("diag", [st.Diag.NonUnit, st.Diag.Unit])
+def test_trmm_mesh(rng, side, uplo, diag):
+    g = _grid(2, 2)
+    n, m, nb = 20, 12, 4
+    a = rng.standard_normal((n, n))
+    A = st.TriangularMatrix.from_numpy(a, nb, uplo, diag, g)
+    tri = np.tril(a) if uplo is st.Uplo.Lower else np.triu(a)
+    if diag is st.Diag.Unit:
+        tri = tri - np.diag(np.diag(tri)) + np.eye(n)
+    if side == "l":
+        b = rng.standard_normal((n, m))
+        ref = 2.0 * tri @ b
+    else:
+        b = rng.standard_normal((m, n))
+        ref = 2.0 * b @ tri
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    out = st.trmm(side, 2.0, A, B, {st.Option.Target: st.Target.mesh})
+    np.testing.assert_allclose(out.to_numpy(), ref, rtol=1e-11, atol=1e-11)
+
+
+def test_trmm_mesh_ragged(rng):
+    g = _grid(2, 2)
+    n, m, nb = 18, 10, 4                    # ragged last tiles
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, m))
+    A = st.TriangularMatrix.from_numpy(a, nb, st.Uplo.Lower,
+                                       st.Diag.NonUnit, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    out = st.trmm("l", 1.0, A, B, {st.Option.Target: st.Target.mesh})
+    np.testing.assert_allclose(out.to_numpy(), np.tril(a) @ b,
+                               rtol=1e-11, atol=1e-11)
+
+
+@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+def test_gemmA_mesh(rng, p, q):
+    g = _grid(p, q)
+    m, k, nb = 32, 24, 4
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, nb))        # single block column: gemmA turf
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    out = st.gemmA(1.0, A, B)
+    np.testing.assert_allclose(out.to_numpy(), a @ b, rtol=1e-11, atol=1e-11)
+    # auto-selection picks gemmA for nt < 2 (method.hh:87-98): same result
+    out2 = st.gemm(1.0, A, B)
+    np.testing.assert_allclose(out2.to_numpy(), a @ b, rtol=1e-11,
+                               atol=1e-11)
+
+
+def test_gemmA_mesh_wide_and_beta(rng):
+    g = _grid(2, 2)
+    m, k, n, nb = 16, 24, 12, 4
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    c = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    C = st.Matrix.from_numpy(c, nb, nb, g)
+    out = st.gemmA(0.5, A, B, 2.0, C)
+    np.testing.assert_allclose(out.to_numpy(), 0.5 * a @ b + 2.0 * c,
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_hemmA_mesh(rng):
+    g = _grid(2, 2)
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, nb))
+    H = st.HermitianMatrix.from_numpy(a, nb, grid=g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    hd = np.tril(a) + np.tril(a, -1).T
+    out = st.hemmA("l", 1.0, H, B)
+    np.testing.assert_allclose(out.to_numpy(), hd @ b, rtol=1e-11,
+                               atol=1e-11)

@@ -16,6 +16,7 @@ def _mat(rng, m, n, dtype=np.float64):
 
 @pytest.mark.parametrize("m,n,nb", [(16, 16, 4), (24, 13, 5), (13, 24, 5),
                                     (8, 8, 8), (30, 7, 4)])
+@pytest.mark.slow
 def test_svd_values(rng, m, n, nb):
     a = _mat(rng, m, n)
     A = st.Matrix.from_numpy(a, nb, nb)
@@ -25,6 +26,7 @@ def test_svd_values(rng, m, n, nb):
 
 
 @pytest.mark.parametrize("m,n,nb", [(16, 16, 4), (20, 11, 5), (11, 20, 5)])
+@pytest.mark.slow
 def test_svd_vectors(rng, m, n, nb):
     a = _mat(rng, m, n)
     A = st.Matrix.from_numpy(a, nb, nb)
@@ -41,6 +43,7 @@ def test_svd_vectors(rng, m, n, nb):
                                atol=1e-10)
 
 
+@pytest.mark.slow
 def test_svd_complex(rng):
     m, n, nb = 14, 10, 4
     a = _mat(rng, m, n, np.complex128)
@@ -53,6 +56,7 @@ def test_svd_complex(rng):
                                np.linalg.svd(a, compute_uv=False), atol=1e-10)
 
 
+@pytest.mark.slow
 def test_svd_mesh_grid(rng):
     # distributed storage in, gathered two-stage reduction (ref svd.cc
     # gathers the band the same way, ge2tbGather)
