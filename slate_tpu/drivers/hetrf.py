@@ -1,18 +1,21 @@
-"""Symmetric-indefinite solvers: hetrf / hetrs / hesv (Aasen).
+"""Symmetric-indefinite solvers: hetrf / hetrs / hesv (blocked Aasen).
 
-Analog of the reference's Aasen chain (ref: src/hetrf.cc:1-619 — Aasen's
-factorization P A P^H = L T L^H with L unit lower triangular, first column
-e_0, and T a band matrix solved by band LU; src/hetrs.cc applies
-L / T / L^H in sequence; src/hesv.cc drives both).
+Analog of the reference's Aasen chain (ref: src/hetrf.cc:1-619 — blocked
+Aasen factorization P A P^H = L T L^H with L unit lower triangular whose
+first block column is [I; 0], and T a Hermitian BAND matrix of bandwidth
+nb factored by band LU; src/hetrs.cc applies L / T / L^H in sequence;
+src/hesv.cc drives both).
 
-TPU-first shape: the factorization is ONE lax.fori_loop over columns — each
-step is a full-height gemv against the accumulated L (H = T L^H recurrence,
-Higham ASNA ch. 11 formulation), a masked argmax pivot, and two masked row
-writes.  Static shapes throughout; pivoting is tracked as a permutation
-vector (symmetric row+column gather, never a materialized P A P^H).  The
-tridiagonal T solve reuses the pivoted band LU (internal/band.py, kl=ku=1)
-— the same "solve T by band LU" choice the reference makes (hetrf.cc
-factors T with gbtrf).
+TPU-first shape: the reference's panel/update task graph becomes a
+statically-unrolled loop over ~n/nb block columns where the hot operation
+per step is ONE tall gemm ``W = A[j0:, j] - L[j0:, :j0] @ H[:j0, j]`` —
+n³/3 total flops, all MXU-shaped (the r3 column-at-a-time gemv recurrence
+forfeited all blocking; this is the fix).  Pivoting is confined to the
+panel LU (internal/getrf.panel_lu), exactly the reference's scheme, so no
+precomputed panel data is ever invalidated; pivots are applied as one
+symmetric row/column gather per panel.  T's band LU solve reuses the
+packed-band kernels (internal/band.py gbtrf/gbtrs with kl = ku = nb),
+the same "factor T with gbtrf" choice the reference makes.
 """
 
 from __future__ import annotations
@@ -27,136 +30,197 @@ from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.band import gbtrf_banded, gbtrs_banded
+from ..internal.getrf import panel_lu
 from ..options import Options
 from ..types import is_complex
 
 
 class HEFactors(NamedTuple):
-    """Aasen factors: P A P^H = L T L^H.  ``L`` dense unit-lower [n, n]
-    (column 0 = e_0), ``d`` real diagonal of T, ``e`` subdiagonal of T,
-    ``piv`` the row/column permutation (A[piv][:, piv] = L T L^H)."""
+    """Blocked Aasen factors: P A P^H = L T L^H.
+
+    ``L``     [n, n] dense unit lower (block column 0 = [I; 0])
+    ``Tdiag`` [Nt, nb, nb] Hermitian diagonal blocks of T (padded space)
+    ``Tsub``  [Nt-1, nb, nb] subdiagonal blocks T[j+1, j] (upper
+              triangular — the panel LU's U factors); T[j, j+1] = Tsub^H
+    ``piv``   [n] row/column permutation: A[piv][:, piv] = L T L^H
+    ``nb``    panel width = T's bandwidth
+    ``Tlu``/``Tperms``  T's band-LU factors, computed ONCE here so every
+              hetrs reuses them (ref: hetrf.cc factors T with gbtrf
+              inside the factorization)
+    """
     L: jax.Array
-    d: jax.Array
-    e: jax.Array
+    Tdiag: jax.Array
+    Tsub: jax.Array
     piv: jax.Array
+    nb: int
+    Tlu: jax.Array
+    Tperms: jax.Array
+
+    @property
+    def n(self) -> int:
+        return self.L.shape[0]
+
+    def T_dense(self):
+        """Assemble the band matrix T as a dense [n, n] array."""
+        nb = self.nb
+        Nt = self.Tdiag.shape[0]
+        n_pad = Nt * nb
+        t = jnp.zeros((n_pad, n_pad), self.Tdiag.dtype)
+        for j in range(Nt):
+            j0 = j * nb
+            t = t.at[j0:j0 + nb, j0:j0 + nb].set(self.Tdiag[j])
+            if j + 1 < Nt:
+                t = t.at[j0 + nb:j0 + 2 * nb, j0:j0 + nb].set(self.Tsub[j])
+                t = t.at[j0:j0 + nb, j0 + nb:j0 + 2 * nb].set(
+                    jnp.conj(self.Tsub[j]).T)
+        return t[: self.n, : self.n]
 
 
-def _aasen(a):
-    """Scalar Aasen with partial pivoting on a dense Hermitian matrix
-    (both triangles populated).  Returns (L, d, e, piv)."""
-    n = a.shape[0]
+def _blocks_of_row(L, j0, j1, nb):
+    """L[j0:j1, :j1] as [j+1, nb, nb] block array (block m = L[j, m])."""
+    j = j0 // nb
+    row = L[j0:j1, :j1]
+    return row.reshape(j1 - j0, j + 1, nb).transpose(1, 0, 2)
+
+
+def _aasen_blocked(a, nb: int):
+    """Blocked Aasen on a dense Hermitian matrix (both triangles
+    populated).  Returns (L, Tdiag, Tsub, piv) over the nb-padded space
+    (pad block = identity; pivots never select the zero pad rows)."""
+    n0 = a.shape[0]
     dt = a.dtype
-    rdt = jnp.real(a).dtype
-    idx = jnp.arange(n)
+    Nt = max(1, -(-n0 // nb))
+    n = Nt * nb
+    ap = jnp.zeros((n, n), dt).at[:n0, :n0].set(a)
+    pad = jnp.arange(n0, n)
+    ap = ap.at[pad, pad].set(1)
 
-    L0 = jnp.zeros((n, n), dt).at[:, 0].set(
-        jnp.zeros((n,), dt).at[0].set(1))
-    d0 = jnp.zeros((n,), rdt)
-    e0 = jnp.zeros((n,), dt)                      # e[j] = T[j+1, j]
-    piv0 = idx
+    L = jnp.zeros((n, n), dt).at[jnp.arange(nb), jnp.arange(nb)].set(1)
+    Tdiag = jnp.zeros((Nt, nb, nb), dt)
+    Tsub = jnp.zeros((max(Nt - 1, 1), nb, nb), dt)
+    piv = jnp.arange(n)
 
-    def body(j, carry):
-        L, d, e, piv = carry
-        # permuted column j of A: A[piv, piv[j]]
-        pj = jnp.take(piv, j)
-        acol = jnp.take(a[:, :], pj, axis=1)
-        acol = jnp.take(acol, piv, axis=0)        # [n]
+    for j in range(Nt):
+        j0, j1 = j * nb, (j + 1) * nb
+        Ljj = L[j0:j1, j0:j1]
+        if j > 0:
+            # H[k, j] = T[k,k-1] L[j,k-1]^H + T[k,k] L[j,k]^H
+            #           + T[k,k+1] L[j,k+1]^H   for k < j
+            Lb = _blocks_of_row(L, j0, j1, nb)        # [j+1, nb, nb]
+            LbH = jnp.conj(Lb).transpose(0, 2, 1)
+            H = jnp.einsum("kab,kbc->kac", Tdiag[:j], LbH[:j])
+            if j > 1:
+                H = H.at[1:].add(jnp.einsum("kab,kbc->kac",
+                                            Tsub[: j - 1], LbH[: j - 1]))
+            TsubH = jnp.conj(Tsub[:j]).transpose(0, 2, 1)
+            H = H + jnp.einsum("kab,kbc->kac", TsubH, LbH[1: j + 1])
+            Hflat = H.reshape(j * nb, nb)
+            # the hot op: one tall MXU gemm (ref: hetrf.cc trailing gemms)
+            W = ap[j0:, j0:j1] - L[j0:, :j0] @ Hflat
+        else:
+            W = ap[:, :nb]
 
-        # H[k, j] = e[k-1] conj(L[j,k-1]) + d[k] conj(L[j,k])
-        #           + conj(e[k]) conj(L[j,k+1]),  for k < j
-        lrow = jnp.conj(jnp.take(L, j, axis=0))   # conj(L[j, :])
-        lm1 = jnp.concatenate([jnp.zeros((1,), dt), lrow[:-1]])
-        lp1 = jnp.concatenate([lrow[1:], jnp.zeros((1,), dt)])
-        em1 = jnp.concatenate([jnp.zeros((1,), dt), e[:-1]])
-        h = em1 * lm1 + d.astype(dt) * lrow + jnp.conj(e) * lp1
-        h = jnp.where(idx < j, h, jnp.zeros_like(h))
+        Hjj = lax.linalg.triangular_solve(
+            Ljj, W[:nb], left_side=True, lower=True, unit_diagonal=True)
+        rhs = Hjj if j == 0 else (
+            Hjj - Tsub[j - 1] @ jnp.conj(L[j0:j1, j0 - nb:j0]).T)
+        Tjj = lax.linalg.triangular_solve(
+            Ljj, rhs, left_side=False, lower=True, transpose_a=True,
+            conjugate_a=True, unit_diagonal=True)
+        Tjj = (Tjj + jnp.conj(Tjj).T) / 2
+        Tdiag = Tdiag.at[j].set(Tjj)
 
-        w = acol - L @ h                          # [n] gemv (the hot op)
-        hj = jnp.take(w, j)
-        ljm1 = jnp.take(lm1, j)                   # conj(L[j, j-1])
-        ejm1 = jnp.take(em1, j)                   # e[j-1]
-        dj = hj - ejm1 * ljm1
-        d = d.at[j].set(jnp.real(dj) if is_complex(dt) else dj.astype(rdt))
+        if j + 1 < Nt:
+            V = W[nb:] - L[j1:, j0:j1] @ Hjj
+            R = lax.linalg.triangular_solve(
+                Ljj, V, left_side=False, lower=True, transpose_a=True,
+                conjugate_a=True, unit_diagonal=True)   # = L[j1:, j+1] T[j+1,j]
+            lu, perm = panel_lu(R)                      # R[perm] = Lp Up
+            Lp = jnp.tril(lu, -1) + jnp.eye(n - j1, nb, dtype=dt)
+            Tsub = Tsub.at[j].set(jnp.triu(lu[:nb]))
+            # symmetric pivot application to the trailing rows/columns
+            rp = jnp.arange(n).at[j1:].set(j1 + perm)
+            ap = ap[rp][:, rp]
+            L = L[rp]
+            piv = piv[rp]
+            L = L.at[j1:, j1:j1 + nb].set(Lp)
 
-        r = w - jnp.take(L, j, axis=1) * hj
-        r = jnp.where(idx > j, r, jnp.zeros_like(r))
-
-        # pivot: largest |r| among rows > j; swap rows j+1 <-> p
-        live = j + 1 < n
-        jp1 = jnp.minimum(j + 1, n - 1)
-        p = jnp.argmax(jnp.where(idx > j, jnp.abs(r),
-                                 -jnp.ones_like(jnp.abs(r))))
-        p = jnp.where(live, p, jp1)
-
-        def swap_vec(v):
-            vj, vp = jnp.take(v, jp1), jnp.take(v, p)
-            return v.at[jp1].set(vp).at[p].set(vj)
-
-        r = swap_vec(r)
-        piv_new = swap_vec(piv)
-        rowj, rowp = jnp.take(L, jp1, axis=0), jnp.take(L, p, axis=0)
-        L_sw = L.at[jp1].set(rowp).at[p].set(rowj)
-
-        ej = jnp.take(r, jp1)
-        safe = jnp.where(jnp.abs(ej) > 0, ej, jnp.ones_like(ej))
-        newcol = jnp.where(idx > j + 1, r / safe, jnp.zeros_like(r))
-        newcol = newcol.at[jp1].set(jnp.ones((), dt))
-        e_new = e.at[j].set(jnp.where(live, ej, jnp.zeros_like(ej)))
-        Lcol = jnp.where(live, newcol, jnp.take(L_sw, jp1, axis=1))
-        L_new = L_sw.at[:, jp1].set(Lcol)
-
-        L = jnp.where(live, L_new, L)
-        piv = jnp.where(live, piv_new, piv)
-        e = jnp.where(live, e_new, e)
-        return L, d, e, piv
-
-    L, d, e, piv = lax.fori_loop(0, n, body, (L0, d0, e0, piv0))
-    return L, d, e[: max(n - 1, 0)], piv
+    return L[:n0, :n0], Tdiag, Tsub, piv[:n0]
 
 
 def hetrf(A, opts: Options | None = None) -> HEFactors:
-    """Aasen factorization of a Hermitian indefinite matrix
-    (ref: src/hetrf.cc).  Returns HEFactors."""
+    """Blocked Aasen factorization of a Hermitian indefinite matrix
+    (ref: src/hetrf.cc).  Returns HEFactors; T has bandwidth A.nb.
+
+    The recurrence amplifies matmul rounding, so the factorization pins
+    true-f32 multiplication (TPU's default bf16-pass matmul loses the
+    factorization entirely at n in the thousands)."""
     slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
                 "hetrf: need HermitianMatrix/SymmetricMatrix")
     slate_error(isinstance(A, HermitianMatrix) or not is_complex(A.dtype),
                 "hetrf: complex SymmetricMatrix unsupported (use "
                 "HermitianMatrix)")
+    nb = A.nb
     ad = A.to_dense()
-    L, d, e, piv = _aasen(ad)
-    return HEFactors(L, d, e, piv)
+    with jax.default_matmul_precision("highest"):
+        L, Tdiag, Tsub, piv = _aasen_blocked(ad, nb)
+        n0 = L.shape[0]
+        kd = min(nb, max(n0 - 1, 0))
+        gp = _packed_band_T(Tdiag, Tsub, nb, n0, kd)  # [2kd+1, n0]
+        work = jnp.zeros((3 * kd + 1, n0), gp.dtype).at[kd:].set(gp)
+        w = min(max(nb, 1), max(n0, 1))
+        Tlu, Tperms = gbtrf_banded(work, kd, kd, n0, w)
+    return HEFactors(L, Tdiag, Tsub, piv, nb, Tlu, Tperms)
 
 
-def _tridiag_solve_piv(d, e, b):
-    """Pivoted solve of the Hermitian tridiagonal T (diagonal d, subdiag e)
-    against b — via the in-house band LU with kl = ku = 1 (stable for
-    indefinite T, unlike the Thomas algorithm)."""
-    n = d.shape[0]
-    dt = jnp.result_type(d.dtype, e.dtype if e.size else d.dtype, b.dtype)
-    gp = jnp.zeros((3, n), dt)
-    gp = gp.at[1].set(d.astype(dt))
-    if n > 1:
-        gp = gp.at[2, :-1].set(e.astype(dt))      # sub: A[j+1, j] at col j
-        gp = gp.at[0, 1:].set(jnp.conj(e).astype(dt))   # super at col j+1
-    work = jnp.zeros((4, n), dt).at[1:].set(gp)   # +kl fill row on top
-    w = min(8, max(n, 1))
-    lu, perms = gbtrf_banded(work, 1, 1, n, w)
-    return gbtrs_banded(lu, perms, 1, 1, n, w, b.astype(dt))
+def _packed_band_T(Tdiag, Tsub, nb: int, n0: int, kd: int):
+    """General packed band [2kd+1, n0] of T straight from its block
+    arrays (no dense assembly): P[kd + i - c, c] = T[i, c] with the three
+    block cases diag / sub / super-as-conj-sub."""
+    dt = Tdiag.dtype
+    Nt = Tdiag.shape[0]
+    rr = jnp.arange(2 * kd + 1)[:, None]
+    c = jnp.arange(n0)[None, :]
+    i = c + rr - kd                                   # global row index
+    bi, il = i // nb, i % nb
+    bc, cl = c // nb, c % nb
+    valid = (i >= 0) & (i < n0)
+    bis = jnp.clip(bi, 0, Nt - 1)
+    diag = Tdiag[jnp.clip(bc, 0, Nt - 1), jnp.clip(il, 0, nb - 1), cl]
+    if Tsub.shape[0]:
+        sub = Tsub[jnp.clip(bc, 0, Tsub.shape[0] - 1),
+                   jnp.clip(il, 0, nb - 1), cl]
+        sup = jnp.conj(Tsub[jnp.clip(bis, 0, Tsub.shape[0] - 1),
+                            cl, jnp.clip(il, 0, nb - 1)])
+    else:
+        sub = sup = jnp.zeros_like(diag)
+    out = jnp.where(bi == bc, diag,
+                    jnp.where(bi == bc + 1, sub,
+                              jnp.where(bi == bc - 1, sup,
+                                        jnp.zeros((), dt))))
+    return jnp.where(valid, out, jnp.zeros((), dt))
 
 
 def hetrs(F: HEFactors, B, opts: Options | None = None):
     """Solve from Aasen factors (ref: src/hetrs.cc):
-    x = P^H L^-H T^-1 L^-1 P b."""
+    x = P^H L^-H T^-1 L^-1 P b.  T's band-LU factors come precomputed in
+    HEFactors; matmul precision pinned for the same reason as hetrf."""
     b = B.to_dense() if isinstance(B, Matrix) else jnp.asarray(B)
-    bp = jnp.take(b, F.piv, axis=0)
-    z = lax.linalg.triangular_solve(F.L, bp, left_side=True, lower=True,
-                                    unit_diagonal=True)
-    y = _tridiag_solve_piv(F.d, F.e, z)
-    wv = lax.linalg.triangular_solve(F.L, y.astype(F.L.dtype),
-                                     left_side=True, lower=True,
-                                     transpose_a=True, conjugate_a=True,
-                                     unit_diagonal=True)
-    x = jnp.zeros_like(wv).at[F.piv].set(wv)
+    n0 = F.n
+    nb = F.nb
+    kd = min(nb, max(n0 - 1, 0))
+    w = min(max(nb, 1), max(n0, 1))
+    with jax.default_matmul_precision("highest"):
+        bp = jnp.take(b, F.piv, axis=0)
+        z = lax.linalg.triangular_solve(F.L, bp, left_side=True,
+                                        lower=True, unit_diagonal=True)
+        y = gbtrs_banded(F.Tlu, F.Tperms, kd, kd, n0, w,
+                         z.astype(F.Tlu.dtype))
+        wv = lax.linalg.triangular_solve(F.L, y.astype(F.L.dtype),
+                                         left_side=True, lower=True,
+                                         transpose_a=True, conjugate_a=True,
+                                         unit_diagonal=True)
+        x = jnp.zeros_like(wv).at[F.piv].set(wv)
     if isinstance(B, Matrix):
         return Matrix(TileStorage.from_dense(x, B.mb, B.nb, B.grid))
     return x

@@ -1,0 +1,48 @@
+"""Tracing: the label-every-op discipline.
+
+Analog of the reference's trace::Block RAII instrumentation (ref:
+include/slate/internal/Trace.hh:103-110 — every kernel, MPI call and
+memcpy opens a named block; Trace.cc:359-448 renders the SVG timeline).
+
+On TPU the timeline renderer is jax.profiler (Perfetto/TensorBoard), so
+the framework's job is to NAME things: :func:`span` opens both a host-side
+profiler TraceAnnotation (visible on the host timeline) and a
+jax.named_scope (labels the emitted XLA ops, so device-side kernels in a
+profile carry driver/phase names like ``slate.potrf/panel``).
+
+Capture a profile the standard jax way::
+
+    with jax.profiler.trace("/tmp/jax-trace"):
+        st.posv(A, B)
+    # tensorboard --logdir /tmp/jax-trace  ->  named phases
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Named block around driver/kernel phases (trace::Block analog).
+
+    Safe both outside jit (host annotation) and while tracing (XLA op
+    names)."""
+    with jax.profiler.TraceAnnotation(name):
+        with jax.named_scope(name):
+            yield
+
+
+def annotate(name: str):
+    """Decorator form of :func:`span` for whole drivers."""
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco

@@ -1,5 +1,6 @@
-"""Aasen symmetric-indefinite tests (analog of ref test/test_hesv.cc):
-factorization residual P A P^H = L T L^H and solve residual vs numpy."""
+"""Blocked-Aasen symmetric-indefinite tests (analog of ref
+test/test_hesv.cc): factorization residual P A P^H = L T L^H with band T,
+structure checks, and solve residual vs numpy."""
 
 import numpy as np
 import pytest
@@ -18,28 +19,30 @@ def herm_indef(rng, n, dtype=np.float64):
     return a
 
 
-def tridiag(d, e):
-    n = len(d)
-    T = np.diag(d.astype(complex if np.iscomplexobj(e) else float))
-    if n > 1:
-        T = T + np.diag(e, -1) + np.diag(np.conj(e), 1)
-    return T
-
-
-@pytest.mark.parametrize("n,nb", [(16, 4), (23, 5), (8, 8), (1, 4), (2, 4)])
+@pytest.mark.parametrize("n,nb", [(16, 4), (23, 5), (8, 8), (1, 4), (2, 4),
+                                  (40, 8)])
 def test_hetrf_residual(rng, n, nb):
     a = herm_indef(rng, n)
     A = st.SymmetricMatrix.from_numpy(a, nb)
     F = st.hetrf(A)
     L = np.asarray(F.L)
-    T = tridiag(np.asarray(F.d), np.asarray(F.e))
+    T = np.asarray(F.T_dense())
     piv = np.asarray(F.piv)
     ap = a[piv][:, piv]
     np.testing.assert_allclose(L @ T @ L.conj().T, ap, atol=1e-10)
-    # L unit lower, first column e_0
+    # L unit lower, first block column [I; 0]
     np.testing.assert_allclose(np.triu(L, 1), 0, atol=0)
     np.testing.assert_allclose(np.diagonal(L), 1, atol=1e-14)
-    np.testing.assert_allclose(L[1:, 0], 0, atol=0)
+    w0 = min(n, nb)
+    np.testing.assert_allclose(L[:, :w0], np.eye(n, w0), atol=0)
+    # T is a Hermitian band of bandwidth nb with upper-triangular
+    # subdiagonal blocks (the panel LU's U factors, ref hetrf.cc)
+    np.testing.assert_allclose(T, T.conj().T, atol=1e-12)
+    np.testing.assert_allclose(np.tril(T, -(nb + 1)), 0, atol=0)
+    if F.Tsub.shape[0] and n > nb:
+        for j in range(F.Tdiag.shape[0] - 1):
+            np.testing.assert_allclose(
+                np.tril(np.asarray(F.Tsub[j]), -1), 0, atol=0)
 
 
 def test_hetrf_complex(rng):
@@ -47,7 +50,7 @@ def test_hetrf_complex(rng):
     a = herm_indef(rng, n, np.complex128)
     F = st.hetrf(st.HermitianMatrix.from_numpy(a, nb))
     L = np.asarray(F.L)
-    T = tridiag(np.asarray(F.d), np.asarray(F.e))
+    T = np.asarray(F.T_dense())
     piv = np.asarray(F.piv)
     np.testing.assert_allclose(L @ T @ L.conj().T, a[piv][:, piv],
                                atol=1e-10)
@@ -80,3 +83,17 @@ def test_hesv_singularish(rng):
     F, X = st.hesv(st.SymmetricMatrix.from_numpy(a, nb),
                    st.Matrix.from_numpy(b, nb, nb))
     np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-8)
+
+
+@pytest.mark.slow
+def test_hesv_moderate_n(rng):
+    """Blocked path at a few hundred rows: the hot op is panel gemms, so
+    this must run in seconds, with a well-scaled residual."""
+    n, nb = 384, 64
+    a = herm_indef(rng, n)
+    b = rng.standard_normal((n, 4))
+    F, X = st.hesv(st.SymmetricMatrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    resid = np.linalg.norm(a @ X.to_numpy() - b) / (
+        np.linalg.norm(a) * np.linalg.norm(X.to_numpy()))
+    assert resid < 1e-13
