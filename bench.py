@@ -1,19 +1,39 @@
-"""Headline benchmark for the driver: prints ONE JSON line.
+"""Headline benchmarks for the driver: prints one JSON line PER metric.
 
-Measures framework gemm throughput on the available accelerator (BASELINE.md
-config #1 family).  Baseline: the reference's only in-repo absolute number —
-dgemm n=10000, 4 ranks x 1 GPU, 0.712 s (docs/usage.md:41-42) = 2*n^3/t/4 ≈
-702 GFLOP/s per GPU.  We report GFLOP/s per chip for the framework's gemm at
-n=4096 (f32 — TPU v5e has no native f64; the mixed-precision solvers are the
-f64-accuracy path, see slate_tpu/drivers/mixed.py).
+Covers BASELINE.md configs 1-4 (single-chip, single-target — the per-chip
+building block of the 2D-grid configs) plus raw-MXU context:
+
+  gemm   n=4096  f32  (config #1, kept for cross-round continuity)
+  gemm   n=8192  f32  (larger-tile point where the chip leaves dispatch
+                       overhead behind; closer to the chip's real ceiling)
+  posv   n=16384 f32  (config #2 family: potrf + potrs, nrhs=256)
+  gesv   n=16384 f32  (config #3 family: getrf partial pivot + getrs)
+  geqrf  131072x1024  (config #4: tall-skinny Householder QR)
+  gels   131072x1024  (config #4: least squares, auto method = CholQR)
+
+Each line reports GFLOP/s/chip and ``mfu`` — the fraction of the chip's
+dense-matmul peak (see _chip_peak; on TPU the MXU computes bf16 x bf16 ->
+f32, and XLA's default f32 matmul runs single-pass at that same rate, so one
+peak number applies to both precisions).  FLOP formulas follow the reference
+tester: gemm 2mnk (ref: src/gemm.cc:24), potrf n^3/3 + solve 2n^2*nrhs
+(ref: src/potrf.cc:334), getrf 2n^3/3 + solve, geqrf 2mn^2 - 2n^3/3
+(testsweeper gflop helpers); gels reports the same nominal flops as the QR
+path regardless of method, as the reference tester does.
 
 Timing: the remote-tunnel platform makes block_until_ready a no-op and a
-host fetch costs ~70 ms round trip, so we chain ``iters`` dependent gemms
-inside one jitted scan and fetch one element — the round trip is amortised
-and each step truly depends on the previous (no dead-code elimination).
+host fetch costs ~70 ms round trip, so each benchmark chains ``iters``
+DEPENDENT solves inside one jitted lax.scan (a scalar distilled from each
+result perturbs the next input, so nothing is dead code and steps cannot
+overlap) and fetches one element once.
+
+``vs_baseline`` is value / 702 GFLOP/s — the only absolute number the
+reference repo publishes (dgemm n=10000, 4 ranks x 1 GPU, 0.712 s =
+702 GFLOP/s per GPU, ref docs/usage.md:41-42).  Set SLATE_BENCH_QUICK=1 for
+a seconds-scale smoke run of the same harness at toy sizes.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -22,46 +42,178 @@ import numpy as np
 from jax import lax
 
 import slate_tpu as st
+from slate_tpu.core.storage import TileStorage
 
 BASELINE_GFLOPS_PER_CHIP = 702.0  # ref docs/usage.md:41-42, per-GPU dgemm
+QUICK = bool(int(os.environ.get("SLATE_BENCH_QUICK", "0")))
 
 
-def bench_gemm(n=4096, nb=256, iters=50, reps=3):
+def _chip_peak():
+    """(dense matmul peak FLOP/s, device_kind) for MFU; None if unknown.
+
+    Public spec-sheet bf16 MXU peaks per chip generation.  XLA's default
+    (single-pass) f32 matmul runs at the same MXU rate.
+    """
+    kind = jax.devices()[0].device_kind.lower()
+    table = [("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12),
+             ("v5e", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12)]
+    for key, peak in table:
+        if key in kind:
+            return peak, kind
+    return None, kind
+
+
+PEAK, CHIP = None, "cpu"
+
+
+def _mat(dense, mb, nb):
+    return st.Matrix(TileStorage.from_dense(dense, mb, nb))
+
+
+def _time_chain(body, init, args, iters, flops_per_iter, reps=3):
+    """Best-of-reps GFLOP/s for ``iters`` dependent body applications.
+
+    ``args`` (the big operands) are jit ARGUMENTS, not closure constants —
+    the remote-compile tunnel serializes closed-over arrays into the compile
+    request, which both bloats it past the request-size limit and bakes the
+    data into the program."""
+
+    def chained(c0, *ops):
+        c, _ = lax.scan(lambda c, _: (body(c, *ops), None), c0, None,
+                        length=iters)
+        # distil to ONE scalar: fetching a large result through the tunnel
+        # costs seconds and would dominate the measurement
+        while getattr(c, "ndim", 0) > 0:
+            c = c[(0,) * c.ndim]
+        return c
+
+    run = jax.jit(chained)
+    np.asarray(jax.device_get(run(init, *args)))   # compile + warmup
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(jax.device_get(run(init, *args)))
+        times.append(time.perf_counter() - t0)
+    return flops_per_iter * iters / min(times) / 1e9
+
+
+def _emit(metric, gflops, extra=None):
+    line = {
+        "metric": metric,
+        "value": round(float(gflops), 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(float(gflops) / BASELINE_GFLOPS_PER_CHIP, 2),
+        "mfu": (round(gflops * 1e9 / PEAK, 3) if PEAK else None),
+        "chip": CHIP,
+    }
+    if extra:
+        line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def bench_gemm(n, nb, iters):
     rng = np.random.default_rng(0)
     a = rng.standard_normal((n, n)).astype(np.float32)
     b = rng.standard_normal((n, n)).astype(np.float32)
     A = st.Matrix.from_numpy(a, nb, nb)
     B = st.Matrix.from_numpy(b, nb, nb)
 
-    def chained(A, B):
-        def body(carry, _):
-            C = st.gemm(1.0 / n, A, st.Matrix(st.TileStorage(
-                carry, B.storage.m, B.storage.n, B.storage.mb,
-                B.storage.nb, B.storage.grid)))
-            return C.storage.data, None
-        out, _ = lax.scan(body, B.storage.data, None, length=iters)
-        return out
+    def body(carry, adata):
+        # carry IS the tile storage of the running product (no re-tiling)
+        C = st.gemm(1.0 / n, st.Matrix(TileStorage(
+            adata, A.storage.m, A.storage.n, nb, nb, A.storage.grid)),
+            st.Matrix(TileStorage(carry, B.storage.m, B.storage.n, nb, nb,
+                                  B.storage.grid)))
+        return C.storage.data
 
-    run = jax.jit(chained)
-    np.asarray(jax.device_get(run(A, B)[0, 0, 0, 0]))  # compile + warmup
+    gflops = _time_chain(body, B.storage.data, (A.storage.data,), iters,
+                         2.0 * n * n * n)
+    _emit(f"gemm_n{n}_gflops_per_chip", gflops, {"nb": nb})
 
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        np.asarray(jax.device_get(run(A, B)[0, 0, 0, 0]))
-        times.append(time.perf_counter() - t0)
-    t = min(times)
-    return 2.0 * n * n * n * iters / t / 1e9
+
+def bench_posv(n, nb, nrhs, iters):
+    rng = np.random.default_rng(1)
+    # SPD without an O(n^3) host product: symmetrize + diagonal dominance
+    a0 = rng.standard_normal((n, n)).astype(np.float32)
+    a = jnp.asarray(a0 + a0.T) * 0.001 + jnp.eye(n, dtype=jnp.float32) * 4.0
+    b = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
+
+    def body(carry, a, b):
+        H = st.HermitianMatrix._from_view(
+            _mat(a * (1.0 + carry), nb, nb), st.Uplo.Lower)
+        _, X = st.posv(H, _mat(b, nb, nb))
+        return X.to_dense()[0, 0] * 1e-24      # data dependence, ~0
+
+    flops = n**3 / 3.0 + 2.0 * n * n * nrhs
+    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"posv_n{n}_gflops_per_chip", gflops, {"nb": nb, "nrhs": nrhs})
+
+
+def bench_gesv(n, nb, nrhs, iters):
+    rng = np.random.default_rng(2)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
+    # CALU tournament pivoting — BASELINE config #3 specifies the tntpiv
+    # variant (and its bounded-height chunk LUs fit TPU scoped VMEM, which
+    # XLA's monolithic tall-panel LU custom call does not at this size)
+    opts = {st.Option.MethodLU: st.MethodLU.CALU}
+
+    def body(carry, a, b):
+        A = _mat(a * (1.0 + carry), nb, nb)
+        _, X = st.gesv(A, _mat(b, nb, nb), opts)
+        return X.to_dense()[0, 0] * 1e-24
+
+    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
+    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gesv_n{n}_gflops_per_chip", gflops,
+          {"nb": nb, "nrhs": nrhs, "method": "tntpiv"})
+
+
+def bench_geqrf(m, n, nb, iters):
+    rng = np.random.default_rng(3)
+    a = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32))
+
+    def body(carry, a):
+        F = st.geqrf(_mat(a * (1.0 + carry), nb, nb))
+        return F.QR.to_dense()[0, 0] * 1e-24
+
+    flops = 2.0 * m * n * n - 2.0 * n**3 / 3.0
+    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"geqrf_tall_{m}x{n}_gflops_per_chip", gflops, {"nb": nb})
+
+
+def bench_gels(m, n, nb, nrhs, iters):
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((m, nrhs)).astype(np.float32))
+
+    def body(carry, a, b):
+        X = st.gels(_mat(a * (1.0 + carry), nb, nb), _mat(b, nb, nb))
+        return X.to_dense()[0, 0] * 1e-24
+
+    # nominal QR-path flops, as the reference tester reports for any method
+    flops = 2.0 * m * n * n - 2.0 * n**3 / 3.0 + 4.0 * m * n * nrhs
+    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gels_tall_{m}x{n}_gflops_per_chip", gflops,
+          {"nb": nb, "nrhs": nrhs, "method": "cholqr"})
 
 
 def main():
-    gflops = bench_gemm()
-    print(json.dumps({
-        "metric": "gemm_n4096_gflops_per_chip",
-        "value": round(gflops, 1),
-        "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / BASELINE_GFLOPS_PER_CHIP, 2),
-    }))
+    global PEAK, CHIP
+    PEAK, CHIP = _chip_peak()
+    if QUICK:
+        bench_gemm(n=512, nb=128, iters=4)
+        bench_posv(n=768, nb=128, nrhs=64, iters=2)
+        bench_gesv(n=768, nb=128, nrhs=64, iters=2)
+        bench_geqrf(m=4096, n=256, nb=128, iters=2)
+        bench_gels(m=4096, n=256, nb=128, nrhs=16, iters=2)
+        return
+    bench_gemm(n=4096, nb=256, iters=50)
+    bench_gemm(n=8192, nb=512, iters=20)
+    bench_posv(n=16384, nb=512, nrhs=256, iters=5)
+    bench_gesv(n=16384, nb=512, nrhs=256, iters=4)
+    bench_geqrf(m=131072, n=1024, nb=256, iters=4)
+    bench_gels(m=131072, n=1024, nb=256, nrhs=64, iters=4)
 
 
 if __name__ == "__main__":

@@ -50,6 +50,7 @@ from .drivers.band import (  # noqa: F401
     pbtrs, tbsm,
 )
 from .drivers.heev import heev, heev_vals, heevd, hegst, hegv  # noqa: F401
+from .drivers.printing import format_matrix, print_matrix  # noqa: F401
 from .drivers.condest import gecondest, norm1est, trcondest  # noqa: F401
 from .drivers.hetrf import HEFactors, hesv, hetrf, hetrs  # noqa: F401
 from .drivers.svd import svd, svd_vals  # noqa: F401

@@ -33,8 +33,8 @@ from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.qr import (apply_q_left, build_t, householder_panel,
                            householder_vec, phase_of, unit_lower)
-from ..options import Options
-from ..types import Uplo, is_complex
+from ..options import Options, Target, resolve_target
+from ..types import Op, Uplo, is_complex
 
 
 # ---------------------------------------------------------------- stage 1
@@ -90,6 +90,37 @@ def _band_of(a_packed, kd: int):
         diag = jnp.real(diag).astype(a_packed.dtype)
     full = low + jnp.conj(low).T
     return full.at[jnp.arange(n), jnp.arange(n)].set(diag)
+
+
+def _band_diag_tiles(st, off: int):
+    """Gather the tile diagonal at row-offset ``off`` (tiles
+    ``(g + max(off,0), g + max(-off,0))``) straight from cyclic storage —
+    one O(min(Mt,Nt)) tile gather, never a full canonical() reshuffle."""
+    import numpy as np
+    from ..core import layout
+    ci, _, _ = layout.cyclic_row_maps(st.Mt, st.grid.p)
+    cj, _, _ = layout.cyclic_row_maps(st.Nt, st.grid.q)
+    count = min(st.Mt - max(off, 0), st.Nt - max(-off, 0))
+    g = np.arange(max(count, 0))
+    return st.data[ci[g + max(off, 0)], cj[g + max(-off, 0)]]
+
+
+def _band_from_tiles(st, n: int, nb: int):
+    """Assemble the Hermitian band (dense [n, n], both triangles) from the
+    he2hb-packed storage: diagonal tiles + triu of the subdiagonal R blocks
+    (the analog of HermitianBandMatrix::he2hbGather, ref: heev.cc:109-111 —
+    only the O(n nb) band tiles leave the mesh)."""
+    Mt = st.Mt
+    dd = _band_diag_tiles(st, 0)                  # [Mt, nb, nb]
+    ss = _band_diag_tiles(st, 1)                  # [Mt-1] tiles (g+1, g)
+    npad = Mt * nb
+    bd = jnp.zeros((npad, npad), st.dtype)
+    for g in range(Mt):
+        bd = bd.at[g * nb:(g + 1) * nb, g * nb:(g + 1) * nb].set(dd[g])
+        if g + 1 < Mt:
+            bd = bd.at[(g + 1) * nb:(g + 2) * nb, g * nb:(g + 1) * nb].set(
+                jnp.triu(ss[g]))
+    return _band_of(bd[:n, :n], nb)
 
 
 def _unmtr_he2hb(a_packed, Ts, nb: int, Z):
@@ -191,9 +222,10 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
     """Eigendecomposition A = Z diag(w) Z^H for Hermitian/symmetric A
     (ref: src/heev.cc).  Returns (w, Z) — Z is None when jobz=False.
 
-    Mesh matrices are gathered for the reduction (the reference likewise
-    gathers the band to one rank for stage 2, heev.cc:109-111); stage-1
-    distribution is a planned upgrade on this seam.
+    On a mesh, stage 1 (he2hb — all the O(n^3) flops) runs distributed
+    (_heev_mesh -> parallel/dist_he2hb); only the O(n nb) band is gathered
+    for the stage-2 bulge chase, exactly the reference's he2hbGather seam
+    (heev.cc:109-111).
     """
     slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
                 "heev: need HermitianMatrix/SymmetricMatrix")
@@ -205,6 +237,8 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
                 "no eigensolver for complex-symmetric matrices")
     n = A.m
     nb = A.nb
+    if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
+        return _heev_mesh(A, opts, jobz)
     ad = A.to_dense()
     packed, Ts = _he2hb_dense(ad, nb)
     band = _band_of(packed, nb)
@@ -216,6 +250,44 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
     Z = _unmtr_he2hb(packed, Ts, nb, Z)
     Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
     return w, Zm
+
+
+def _heev_mesh(A, opts, jobz: bool):
+    """Mesh path: stage 1 (all the O(n^3) flops) runs DISTRIBUTED via
+    dist_he2hb — the input is never densified; only the O(n nb) band is
+    gathered for stage 2, exactly the reference's he2hbGather seam
+    (ref: heev.cc:104-111).  The Q2 Z_tri product and the Q1
+    back-transform are mesh-distributed (SUMMA gemm + dist_unmtr_he2hb)."""
+    from ..parallel.dist_he2hb import dist_he2hb, dist_unmtr_he2hb
+    from .blas3 import gemm
+    n, nb = A.m, A.nb
+    grid = A.grid
+    # zero-copy for canonical lower storage; ConjTrans is also safe (the
+    # conj-transpose of a Hermitian matrix IS the matrix), as is Trans of a
+    # real symmetric one.  Op.Trans of a complex Hermitian is conj(A) != A —
+    # that view must densify so the op is applied.
+    safe_ops = ((Op.NoTrans, Op.ConjTrans) if is_complex(A.dtype)
+                else (Op.NoTrans, Op.ConjTrans, Op.Trans))
+    if (A.uplo is Uplo.Lower and A.op in safe_ops
+            and A.is_root_view() and A.storage.mb == nb):
+        st_in = A.storage                        # zero-copy, lower-stored
+    else:
+        st_in = TileStorage.from_dense(A.to_dense(), nb, nb, grid)
+    data, Ts = dist_he2hb(st_in.data, st_in.Nt, grid, n=n)
+    st_packed = TileStorage(data, st_in.m, st_in.n, nb, nb, grid)
+    band = _band_from_tiles(st_packed, n, nb)
+    d, e, Q2 = _hb2st(band, nb, want_q=jobz)
+    w, ztri = _tridiag_eig(d, e, jobz)
+    if not jobz:
+        return w, None
+    # Z = Q1 (Q2 Z_tri): inner product as a mesh SUMMA gemm, then the
+    # distributed panel back-transform
+    Q2m = Matrix(TileStorage.from_dense(Q2, nb, nb, grid))
+    Ztm = Matrix(TileStorage.from_dense(ztri.astype(Q2.dtype), nb, nb, grid))
+    Z0 = gemm(1.0, Q2m, Ztm, opts=opts)
+    z_data = dist_unmtr_he2hb(data, Ts, Z0.storage.data, st_in.Nt, grid, n=n)
+    zs = Z0.storage
+    return w, Matrix(TileStorage(z_data, zs.m, zs.n, zs.mb, zs.nb, zs.grid))
 
 
 def heevd(A, opts: Options | None = None):

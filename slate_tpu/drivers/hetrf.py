@@ -135,11 +135,18 @@ def _aasen_blocked(a, nb: int):
             R = lax.linalg.triangular_solve(
                 Ljj, V, left_side=False, lower=True, transpose_a=True,
                 conjugate_a=True, unit_diagonal=True)   # = L[j1:, j+1] T[j+1,j]
-            lu, perm = panel_lu(R)                      # R[perm] = Lp Up
-            Lp = jnp.tril(lu, -1) + jnp.eye(n - j1, nb, dtype=dt)
-            Tsub = Tsub.at[j].set(jnp.triu(lu[:nb]))
+            # pivot only among the LIVE rows (static slice): an exactly-zero
+            # R column ties every row at 0 and XLA's LU may otherwise hand
+            # the pivot to a pad row, leaking an out-of-range index into piv
+            wl = n0 - j1                                # live trailing rows
+            lu, perm = panel_lu(R[:wl])                 # R[perm] = Lp Up
+            Lp = jnp.zeros((n - j1, nb), dt).at[:wl].set(
+                jnp.tril(lu, -1)[:wl] + jnp.eye(wl, nb, dtype=dt))
+            Tsub = Tsub.at[j].set(
+                jnp.zeros((nb, nb), dt).at[:min(wl, nb)].set(
+                    jnp.triu(lu[:nb])[:min(wl, nb)]))
             # symmetric pivot application to the trailing rows/columns
-            rp = jnp.arange(n).at[j1:].set(j1 + perm)
+            rp = jnp.arange(n).at[j1:j1 + wl].set(j1 + perm)
             ap = ap[rp][:, rp]
             L = L[rp]
             piv = piv[rp]

@@ -20,6 +20,7 @@ TPU-first shape mirrors drivers/heev.py:
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.matrix import Matrix
@@ -27,8 +28,8 @@ from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.qr import (apply_q_left, apply_q_right, build_t,
                            householder_panel, householder_vec, phase_of)
-from ..options import Options
-from ..types import is_complex
+from ..options import Options, Target, resolve_target
+from ..types import Op, is_complex
 
 
 # ---------------------------------------------------------------- stage 1
@@ -241,6 +242,8 @@ def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
     if m < n:
         s, V, U = svd(_conj_t_root(A), opts, jobu=jobu)
         return s, U, V
+    if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
+        return _svd_mesh(A, opts, jobu)
     nb = A.nb
     ad = A.to_dense()
     packed, Tqs, Tls = _ge2tb_dense(ad, nb)
@@ -257,6 +260,73 @@ def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
     g = A.grid
     Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
     Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
+    return s, Um, Vm
+
+
+def _band_upper_from_tiles(st, n: int, nb: int):
+    """Assemble the n x n upper band from ge2tb-packed storage: triu of
+    diagonal tiles + tril of superdiagonal tiles, gathered straight from
+    the cyclic data (the analog of TriangularBandMatrix::ge2tbGather,
+    ref: svd.cc:153-160 — only the O(n nb) band tiles leave the mesh)."""
+    from .heev import _band_diag_tiles
+    Ntn = -(-n // nb)
+    dd = _band_diag_tiles(st, 0)[:Ntn]
+    ss = _band_diag_tiles(st, -1)                 # tiles (g, g+1)
+    npad = Ntn * nb
+    bd = jnp.zeros((npad, npad), st.dtype)
+    for g in range(Ntn):
+        bd = bd.at[g * nb:(g + 1) * nb, g * nb:(g + 1) * nb].set(
+            jnp.triu(dd[g]))
+        if g + 1 < Ntn:
+            bd = bd.at[g * nb:(g + 1) * nb,
+                       (g + 1) * nb:(g + 2) * nb].set(jnp.tril(ss[g]))
+    return _band_upper_of(bd[:n, :n], n, nb)
+
+
+def _svd_mesh(A: Matrix, opts, jobu: bool):
+    """Mesh path: stage 1 (all the O(mn^2) flops) runs DISTRIBUTED via
+    dist_ge2tb — the input is never densified; only the O(n nb) band is
+    gathered for stage 2 (the reference's ge2tbGather seam, svd.cc:153).
+    The U2 Ub / V2 Vb products are mesh SUMMA gemms and the stage-1
+    back-transforms are distributed panel applies."""
+    from ..parallel.dist_ge2tb import (dist_ge2tb, dist_unmbr_ge2tb_u,
+                                       dist_unmbr_ge2tb_v)
+    from .blas3 import gemm
+    m, n, nb = A.m, A.n, A.nb
+    grid = A.grid
+    if (A.op is Op.NoTrans and A.is_root_view() and A.storage.mb == nb):
+        st_in = A.storage                        # zero-copy
+    else:
+        st_in = TileStorage.from_dense(A.to_dense(), nb, nb, grid)
+    data, Tqs, Tls = dist_ge2tb(st_in.data, st_in.Mt, st_in.Nt, m, n, grid)
+    st_packed = TileStorage(data, m, n, nb, nb, grid)
+    band = _band_upper_from_tiles(st_packed, n, nb)
+    d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
+    s, Ub, Vbh = _bd_svd(d, e, jobu)
+    if not jobu:
+        return s, None, None
+    U2m = Matrix(TileStorage.from_dense(U2, nb, nb, grid))
+    Ubm = Matrix(TileStorage.from_dense(Ub.astype(U2.dtype), nb, nb, grid))
+    Un = gemm(1.0, U2m, Ubm, opts=opts)          # [n, n] mesh product
+    V2m = Matrix(TileStorage.from_dense(V2, nb, nb, grid))
+    Vbm = Matrix(TileStorage.from_dense(
+        jnp.conj(Vbh.astype(V2.dtype)).T, nb, nb, grid))
+    Vn = gemm(1.0, V2m, Vbm, opts=opts)
+    # U = U1 [Un; 0], V = V1 Vn, both distributed panel chains.  Pad Un
+    # [n, n] to [m, n] in TILE space — a static cyclic-slot scatter, never
+    # a replicated [m, n] dense intermediate (m can be huge for tall A)
+    Uf = Matrix.zeros(m, n, nb, nb, grid, st_packed.dtype)
+    us_, fs_ = Un.storage, Uf.storage
+    gsrc = np.arange(us_.Mt)
+    src = (gsrc % grid.p) * us_.mtl + gsrc // grid.p
+    dst = (gsrc % grid.p) * fs_.mtl + gsrc // grid.p
+    uf_data = fs_.data.at[dst].set(us_.data[src])
+    Uf = Matrix(TileStorage(uf_data, m, n, nb, nb, grid))
+    u_data = dist_unmbr_ge2tb_u(data, Tqs, Uf.storage.data, grid, m)
+    v_data = dist_unmbr_ge2tb_v(data, Tls, Vn.storage.data, grid, n)
+    us, vs = Uf.storage, Vn.storage
+    Um = Matrix(TileStorage(u_data, us.m, us.n, us.mb, us.nb, us.grid))
+    Vm = Matrix(TileStorage(v_data, vs.m, vs.n, vs.mb, vs.nb, vs.grid))
     return s, Um, Vm
 
 

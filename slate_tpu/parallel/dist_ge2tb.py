@@ -1,0 +1,296 @@
+"""Distributed two-stage SVD stage 1: general m x n -> upper band, on mesh.
+
+Analog of the reference's ge2tb driver (ref: src/ge2tb.cc QR+LQ panel
+alternation with internal::geqrf/gelqf + unmqr/unmlq trailing updates;
+back-transforms src/unmbr_ge2tb.cc).
+
+TPU-first shape (ONE shard_map program, superblocked like dist_he2hb):
+
+per panel k                               | here
+----------------------------------------- | -------------------------------
+geqrf on block column k (rows >= k)       | column gathered (scatter+psum),
+                                          |   rolled, factored REPLICATED
+unmqr trailing: C -= V Tq^H V^H C         | one psum of G = V^H C over the
+                                          |   row axis, then local MXU
+                                          |   gemms per rank (cols > k)
+gelqf on block row k (cols >= k+1)        | row gathered, conj-transposed,
+                                          |   rolled, factored REPLICATED
+unmlq trailing: C -= (C Vl) Tl Vl^H       | one psum of H = C Vl over the
+                                          |   column axis, local gemms
+                                          |   (rows > k)
+
+All O(mn^2) trailing flops are mesh-distributed; the skinny panel QR/LQ
+factorizations (O(n nb^2) each) are replicated (the dist_lu trade).  Four
+psums of skinny buffers per panel.  The packed result matches the dense
+_ge2tb_dense layout: QR reflectors below the diagonal, the LQ L block
+merged with conjugated reflector rows above the band, band on/above the
+diagonal (tile (g, g) triu + tile (g, g+1) tril).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..internal.qr import build_t, householder_panel, unit_lower
+from .dist_chol import superblock
+from .dist_he2hb import larfb_left_local, v_from_gathered
+from .dist_lu import _gather_panel
+
+
+def _gather_row(a_loc, k, p, q, ntl, r, c):
+    """Replicate tile-row k on every rank: [q*ntl, nb, nb] (global col
+    tile j at slot j) — the row mirror of dist_lu._gather_panel."""
+    nb = a_loc.shape[-1]
+    kkr = k // p
+    rk = k % p
+    row = lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False)
+    gj_all = c + q * jnp.arange(ntl)
+    buf = jnp.zeros((q * ntl, nb, nb), a_loc.dtype)
+    buf = buf.at[gj_all].set(row)
+    buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
+    return lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+
+
+def _ge2tb_local(a_loc, Mt: int, Ntn: int, m: int, n: int, p: int, q: int,
+                 mtl: int, ntl: int, sb: int):
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    dt = a_loc.dtype
+    K = Ntn                                       # QR panels 0..Ntn-1
+    gi_all = r + p * jnp.arange(mtl)
+    gj_all = c + q * jnp.arange(ntl)
+    zi = jnp.zeros((), jnp.int32)
+    Tqs = jnp.zeros((K, nb, nb), dt)
+    Tls = jnp.zeros((K, nb, nb), dt)
+
+    for k0 in range(0, K, sb):
+        k1s = min(k0 + sb, K)
+        W0 = Mt - k0                              # QR panel window (rows)
+        W0n = Ntn - (k0 + 1)                      # LQ panel window (cols)
+        S = mtl - (k0 // p)                       # row slots with gi >= k0
+        S1 = mtl - ((k0 + 1) // p)                # gi >= k0+1
+        T1 = ntl - ((k0 + 1) // q)                # gj >= k0+1
+
+        def super_step(k, carry, W0=W0, W0n=W0n, S=S, S1=S1, T1=T1, k0=k0):
+            a_loc, Tqs, Tls = carry
+            ki = k.astype(jnp.int32)
+            ck, rk = k % q, k % p
+            kkc, kkr = k // q, k // p
+
+            # ================= QR panel (block column k, rows >= k) ======
+            gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+            panel = gpan[k0: Mt].reshape(W0 * nb, nb)
+            shift = (k - k0) * nb
+            panel = jnp.roll(panel, -shift, axis=0)
+            prow = jnp.arange(W0 * nb)
+            live = prow < (m - k * nb)
+            panel = jnp.where(live[:, None], panel, jnp.zeros_like(panel))
+            packed, taus = householder_panel(panel)
+            Tq = build_t(packed, taus)
+            Tqs = lax.dynamic_update_slice(Tqs, Tq[None], (ki, zi, zi))
+
+            vwin = jnp.roll(unit_lower(packed), shift, axis=0)
+            keepm = ((jnp.arange(W0 * nb) >= shift)
+                     & jnp.roll(live, shift))[:, None]
+            vwin = jnp.where(keepm, vwin, jnp.zeros_like(vwin))
+            vfull = jnp.zeros((p * mtl * nb, nb), dt)
+            vfull = vfull.at[k0 * nb: Mt * nb].set(vwin)
+            Vt = vfull.reshape(p * mtl, nb, nb)
+
+            # write the packed panel back (owner column only)
+            pwin = jnp.roll(packed, shift, axis=0)
+            pwin = jnp.where((jnp.arange(W0 * nb) >= shift)[:, None], pwin,
+                             jnp.zeros_like(pwin))
+            ptiles = pwin.reshape(W0, nb, nb)
+            ptiles_all = jnp.take(ptiles, jnp.clip(gi_all - k0, 0, W0 - 1),
+                                  axis=0)
+            oldcol = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                              keepdims=False)
+            newcol = jnp.where((gi_all >= k)[:, None, None], ptiles_all,
+                               oldcol)
+            col_sel = jnp.where(c == ck, newcol, oldcol)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+
+            # ---- left trailing update on cols > k: C -= V Tq^H (V^H C) --
+            sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
+            sc1 = jnp.clip(-(-(k0 + 1 - c) // q), 0, ntl - T1).astype(
+                jnp.int32)
+            gi = r + p * (sr + jnp.arange(S))
+            gj1 = c + q * (sc1 + jnp.arange(T1))
+            A_w = lax.dynamic_slice(a_loc, (sr, sc1, zi, zi),
+                                    (S, T1, nb, nb))
+            Vr = Vt[gi]
+            G = jnp.einsum('iab,ijac->jbc', jnp.conj(Vr), A_w)
+            G = lax.psum(G, AXIS_P)               # [T1, nb, nb]
+            TG = jnp.einsum('ab,jbc->jac', jnp.conj(Tq).T, G)
+            updl = jnp.einsum('iab,jbc->ijac', Vr, TG)
+            colmask = (gj1 > k)[None, :, None, None]
+            A_w = jnp.where(colmask, A_w - updl, A_w)
+            a_loc = lax.dynamic_update_slice(a_loc, A_w, (sr, sc1, zi, zi))
+
+            # ================= LQ panel (block row k, cols >= k+1) =======
+            # zero-width when (k+1)*nb >= n: all masks below no-op
+            if W0n <= 0:              # static: no columns right of panel
+                return a_loc, Tqs, Tls
+            grow = _gather_row(a_loc, k, p, q, ntl, r, c)
+            rblk = grow[k0 + 1: Ntn]              # [W0n, nb(row), nb(col)]
+            # conj-transpose to column-reflector form [W0n*nb, nb]
+            rpan = jnp.conj(jnp.transpose(rblk, (0, 2, 1))).reshape(
+                W0n * nb, nb)
+            rpan = jnp.roll(rpan, -shift, axis=0)
+            lrow = jnp.arange(W0n * nb)
+            livel = lrow < (n - (k + 1) * nb)
+            rpan = jnp.where(livel[:, None], rpan, jnp.zeros_like(rpan))
+            packed_l, taus_l = householder_panel(rpan)
+            Tl = build_t(packed_l, taus_l)
+            has_lq = (k + 1) * nb < n
+            Tl = jnp.where(has_lq, Tl, jnp.zeros_like(Tl))
+            Tls = lax.dynamic_update_slice(Tls, Tl[None], (ki, zi, zi))
+
+            vlwin = jnp.roll(unit_lower(packed_l), shift, axis=0)
+            keepl = ((jnp.arange(W0n * nb) >= shift)
+                     & jnp.roll(livel, shift))[:, None]
+            vlwin = jnp.where(keepl, vlwin, jnp.zeros_like(vlwin))
+            vlfull = jnp.zeros((q * ntl * nb, nb), dt)
+            vlfull = vlfull.at[(k0 + 1) * nb: Ntn * nb].set(vlwin)
+            Vlt = vlfull.reshape(q * ntl, nb, nb)
+
+            # merged write-back of block row k (L on/below its diagonal,
+            # conjugated reflector rows above — the gelqf packing)
+            iw = jnp.arange(nb)[:, None]          # row within the block
+            jk = jnp.arange(W0n * nb)[None, :]    # ROLLED col (0 = col k1)
+            ell = jnp.conj(jnp.triu(packed_l)).T  # [nb, W0n*nb]
+            vrows = jnp.conj(packed_l).T
+            newblk = jnp.where(jk <= iw, ell, vrows)
+            newblk = jnp.roll(newblk, shift, axis=1)
+            newblk = jnp.where((jnp.arange(W0n * nb) >= shift)[None, :],
+                               newblk, jnp.zeros((1, 1), dt))
+            ntiles = jnp.transpose(newblk.reshape(nb, W0n, nb), (1, 0, 2))
+            ntiles_all = jnp.take(ntiles, jnp.clip(gj_all - (k0 + 1), 0,
+                                                   max(W0n - 1, 0)), axis=0)
+            oldrow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                              keepdims=False)
+            newrow = jnp.where((has_lq & (gj_all >= k + 1))[:, None, None],
+                               ntiles_all, oldrow)
+            row_sel = jnp.where(r == rk, newrow, oldrow)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
+
+            # ---- right trailing update on rows > k: C -= (C Vl) Tl Vl^H -
+            sr1 = jnp.clip(-(-(k0 + 1 - r) // p), 0, mtl - S1).astype(
+                jnp.int32)
+            gi1 = r + p * (sr1 + jnp.arange(S1))
+            B_w = lax.dynamic_slice(a_loc, (sr1, sc1, zi, zi),
+                                    (S1, T1, nb, nb))
+            Vlc = Vlt[gj1]
+            H = jnp.einsum('ijab,jbc->iac', B_w, Vlc)
+            H = lax.psum(H, AXIS_Q)               # [S1, nb, nb]
+            HT = jnp.einsum('iab,bc->iac', H, Tl)
+            updr = jnp.einsum('iac,jbc->ijab', HT, jnp.conj(Vlc))
+            rowmask = (gi1 > k)[:, None, None, None]
+            B_w = jnp.where(rowmask, B_w - updr, B_w)
+            a_loc = lax.dynamic_update_slice(a_loc, B_w, (sr1, sc1, zi, zi))
+            return a_loc, Tqs, Tls
+
+        if W0 <= 0 or S <= 0:
+            continue
+        a_loc, Tqs, Tls = lax.fori_loop(k0, k1s, super_step,
+                                        (a_loc, Tqs, Tls))
+
+    return a_loc, Tqs, Tls
+
+
+def dist_ge2tb(data, Mt: int, Ntn: int, m: int, n: int, grid: Grid,
+               sb: int | None = None):
+    """Reduce cyclic storage of a general m x n (m >= n) matrix to the
+    two-stage upper band form in place.  Returns (data, Tqs, Tls)."""
+    mtl = data.shape[0] // grid.p
+    ntl = data.shape[1] // grid.q
+    sb = sb if sb is not None else superblock(max(Ntn, 1))
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a: _ge2tb_local(a, Mt, Ntn, m, n, grid.p, grid.q, mtl, ntl,
+                               sb),
+        mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P(), P()))
+    return fn(data)
+
+
+def _unmbr_u_local(a_loc, z_loc, Tqs, m: int, p: int, q: int, mtl: int):
+    """Z <- U1 Z, QR panels descending (ref: unmbr_ge2tb U side)."""
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    K = Tqs.shape[0]
+    gi_all = r + p * jnp.arange(mtl)
+
+    def body(i, z_loc):
+        k = K - 1 - i
+        gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+        v = v_from_gathered(gpan.reshape(p * mtl * nb, nb), k * nb, m)
+        Vt = v.reshape(p * mtl, nb, nb)
+        Tk = lax.dynamic_index_in_dim(Tqs, k, axis=0, keepdims=False)
+        return larfb_left_local(z_loc, Vt, Tk, gi_all)
+
+    if K <= 0:
+        return z_loc
+    return lax.fori_loop(0, K, body, z_loc)
+
+
+def _unmbr_v_local(a_loc, z_loc, Tls, n: int, p: int, q: int, ntl: int,
+                   mtl_z: int):
+    """Z <- V1 Z, LQ panels descending (ref: unmbr_ge2tb V side); Z's rows
+    live in A's column space (the LQ reflectors are row-space)."""
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    dt = a_loc.dtype
+    K = Tls.shape[0]
+    gi_all = r + p * jnp.arange(mtl_z)
+    nz_pad = p * mtl_z * nb
+
+    def body(i, z_loc):
+        k = K - 1 - i
+        grow = _gather_row(a_loc, k, p, q, ntl, r, c)
+        rpan = jnp.conj(jnp.transpose(grow, (0, 2, 1))).reshape(
+            q * ntl * nb, nb)
+        v = v_from_gathered(rpan, (k + 1) * nb, n)
+        # re-pad from A's column space to Z's row space
+        vz = jnp.zeros((nz_pad, nb), dt)
+        ncopy = min(nz_pad, q * ntl * nb)
+        vz = vz.at[:ncopy].set(v[:ncopy])
+        Vt = vz.reshape(p * mtl_z, nb, nb)
+        Tk = lax.dynamic_index_in_dim(Tls, k, axis=0, keepdims=False)
+        return larfb_left_local(z_loc, Vt, Tk, gi_all)
+
+    if K <= 0:
+        return z_loc
+    return lax.fori_loop(0, K, body, z_loc)
+
+
+def dist_unmbr_ge2tb_u(a_data, Tqs, z_data, grid: Grid, m: int):
+    """Apply the ge2tb U1 (QR chain) to mesh-distributed Z."""
+    mtl = a_data.shape[0] // grid.p
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, z, t: _unmbr_u_local(a, z, t, m, grid.p, grid.q, mtl),
+        mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
+    return fn(a_data, z_data, Tqs)
+
+
+def dist_unmbr_ge2tb_v(a_data, Tls, z_data, grid: Grid, n: int):
+    """Apply the ge2tb V1 (LQ chain) to mesh-distributed Z (rows in A's
+    column space)."""
+    ntl = a_data.shape[1] // grid.q
+    mtl_z = z_data.shape[0] // grid.p
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, z, t: _unmbr_v_local(a, z, t, n, grid.p, grid.q,
+                                       ntl, mtl_z),
+        mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
+    return fn(a_data, z_data, Tls)

@@ -1,0 +1,256 @@
+"""Distributed two-stage eig stage 1: Hermitian full -> band, on the mesh.
+
+Analog of the reference's he2hb driver + internal kernels
+(ref: src/he2hb.cc:25-600 panel QR + two-sided update task graph;
+src/internal/internal_he2hb_hemm.cc:1-850 Y = A V with Hermitian A read
+from the stored lower triangle; internal_he2hb_her2k_offdiag_ranks.cc:588
+rank-2k trailing update; internal_he2hb_trmm/gemm.cc back-multiplies).
+
+TPU-first shape (ONE shard_map program, superblocked like dist_chol):
+
+per panel k                               | here
+----------------------------------------- | -------------------------------
+geqrf on the panel block column           | panel tile-column gathered to
+  (he2hb.cc:112 internal::geqrf)          |   all ranks (scatter + psum),
+                                          |   rolled to the top, factored
+                                          |   REPLICATED by the fori_loop
+                                          |   Householder kernel (the
+                                          |   dist_lu replicated-panel trade)
+listBcast of V, T to trailing owners      | (absorbed: panel replicated)
+he2hb_hemm: W1 = A V over lower tiles     | per-rank einsum over its static
+  (internal_he2hb_hemm.cc rank lists)     |   trailing window: lower tiles
+                                          |   contribute A_ij V_j -> Y_i AND
+                                          |   A_ij^H V_i -> Y_j, diagonal
+                                          |   tiles Hermitian-completed
+                                          |   in-register; ONE psum -> Y
+W = Y T - 1/2 V (T^H (V^H Y) T)           | replicated skinny ops (V, Y, W
+                                          |   are n x nb, tile-stacked)
+her2k trailing: A -= V W^H + W V^H        | LOCAL einsum on the rank's
+  (her2k_offdiag_ranks)                   |   window — zero communication
+                                          |   (V, W replicated by rows)
+
+The O(n^3) hemm + her2k flops are thus spread across the mesh; only the
+skinny panel QR (O(n nb^2) per panel) is replicated, and communication is
+two psums of [n, nb] buffers per panel.  Ragged last tiles ride the
+pad-rows-are-zero storage invariant (zero rows produce identity reflectors).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..internal.qr import build_t, householder_panel, unit_lower
+from .dist_chol import superblock
+from .dist_lu import _gather_panel
+
+
+def _tril_real_diag(t):
+    """tril(tile) with a real diagonal (Hermitian diag tiles may carry junk
+    imaginary parts in storage; ref: potrf's same completion)."""
+    out = jnp.tril(t)
+    if jnp.iscomplexobj(t):
+        nb = t.shape[-1]
+        eye = jnp.eye(nb, dtype=bool)
+        out = jnp.where(eye, jnp.real(out).astype(t.dtype), out)
+    return out
+
+
+def _he2hb_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
+                 sb: int):
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    dt = a_loc.dtype
+    K = Nt - 1                                   # panels 0..Nt-2
+    gi_all = r + p * jnp.arange(mtl)
+    rows_g = jnp.arange(p * mtl)
+    zi = jnp.zeros((), jnp.int32)
+    Ts = jnp.zeros((max(K, 1), nb, nb), dt)
+    if K <= 0:
+        return a_loc, Ts
+
+    for k0 in range(0, K, sb):
+        k1s = min(k0 + sb, K)
+        # static windows for this superblock: panel rows / trailing tiles
+        # with global index >= k0+1
+        W0 = Nt - (k0 + 1)                       # panel window tiles
+        S = mtl - ((k0 + 1) // p)                # trailing row slots
+        T_ = ntl - ((k0 + 1) // q)               # trailing col slots
+
+        def super_step(k, carry, W0=W0, S=S, T_=T_, k0=k0):
+            a_loc, Ts = carry
+            ck = k % q
+            kkc = k // q
+
+            # ---- gather + factor the panel (replicated) ----
+            gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+            panel = gpan[k0 + 1: Nt].reshape(W0 * nb, nb)
+            shift = (k - k0) * nb
+            panel = jnp.roll(panel, -shift, axis=0)
+            prow = jnp.arange(W0 * nb)
+            live = prow < (n - (k + 1) * nb)     # rows of the active panel
+            panel = jnp.where(live[:, None], panel, jnp.zeros_like(panel))
+            packed, taus = householder_panel(panel)
+            Tk = build_t(packed, taus)
+            Ts = lax.dynamic_update_slice(
+                Ts, Tk[None], (k.astype(jnp.int32), zi, zi))
+
+            # V at full height [p*mtl, nb, nb], tile g = global tile row g
+            vwin = jnp.roll(unit_lower(packed), shift, axis=0)
+            vwin = jnp.where(
+                (jnp.arange(W0 * nb) >= shift)[:, None]
+                & jnp.roll(live, shift)[:, None], vwin, jnp.zeros_like(vwin))
+            vfull = jnp.zeros((p * mtl * nb, nb), dt)
+            vfull = vfull.at[(k0 + 1) * nb: Nt * nb].set(vwin)
+            Vt = vfull.reshape(p * mtl, nb, nb)
+
+            # ---- write the packed panel back (owner column only) ----
+            pwin = jnp.roll(packed, shift, axis=0)
+            pwin = jnp.where((jnp.arange(W0 * nb) >= shift)[:, None], pwin,
+                             jnp.zeros_like(pwin))
+            ptiles = pwin.reshape(W0, nb, nb)
+            ptiles_all = jnp.take(ptiles, jnp.clip(gi_all - (k0 + 1), 0,
+                                                   W0 - 1), axis=0)
+            oldcol = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                              keepdims=False)
+            newcol = jnp.where((gi_all >= k + 1)[:, None, None], ptiles_all,
+                               oldcol)
+            col_sel = jnp.where(c == ck, newcol, oldcol)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+
+            # ---- trailing window (static sizes) ----
+            sr = jnp.clip(-(-(k0 + 1 - r) // p), 0, mtl - S).astype(jnp.int32)
+            sc = jnp.clip(-(-(k0 + 1 - c) // q), 0, ntl - T_).astype(jnp.int32)
+            gi = r + p * (sr + jnp.arange(S))
+            gj = c + q * (sc + jnp.arange(T_))
+            A_win = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
+                                      (S, T_, nb, nb))
+            low = (gi[:, None] > gj[None, :])[:, :, None, None]
+            eq = (gi[:, None] == gj[None, :])[:, :, None, None]
+            Vr = Vt[gi]                          # [S,  nb, nb]
+            Vc = Vt[gj]                          # [T_, nb, nb]
+
+            # ---- Y = A V from the stored lower triangle (he2hb_hemm) ----
+            zer = jnp.zeros_like(A_win)
+            Aeff1 = jnp.where(low, A_win,
+                              jnp.where(eq, _tril_real_diag(A_win), zer))
+            Aeff2 = jnp.where(low, A_win,
+                              jnp.where(eq, jnp.tril(A_win, -1), zer))
+            y1 = jnp.einsum('stab,tbc->sac', Aeff1, Vc)
+            y2 = jnp.einsum('stab,sac->tbc', jnp.conj(Aeff2), Vr)
+            ybuf = jnp.zeros((p * mtl, nb, nb), dt)
+            ybuf = ybuf.at[gi].add(y1)
+            ybuf = ybuf.at[gj].add(y2)
+            Y = lax.psum(lax.psum(ybuf, AXIS_P), AXIS_Q)
+            Y = jnp.where((rows_g > k)[:, None, None], Y, jnp.zeros_like(Y))
+
+            # ---- W = Y T - 1/2 V (T^H (V^H Y) T), replicated skinny ----
+            VY = jnp.einsum('gab,gac->bc', jnp.conj(Vt), Y)
+            inner = jnp.conj(Tk).T @ VY @ Tk
+            Wt = (jnp.einsum('gab,bc->gac', Y, Tk)
+                  - 0.5 * jnp.einsum('gab,bc->gac', Vt, inner))
+
+            # ---- her2k trailing update, fully local ----
+            Wr, Wc = Wt[gi], Wt[gj]
+            upd = (jnp.einsum('sac,tbc->stab', Vr, jnp.conj(Wc))
+                   + jnp.einsum('sac,tbc->stab', Wr, jnp.conj(Vc)))
+            geq = (gi[:, None] >= gj[None, :])[:, :, None, None]
+            new = jnp.where(geq, A_win - upd, A_win)
+            a_loc = lax.dynamic_update_slice(a_loc, new, (sr, sc, zi, zi))
+            return a_loc, Ts
+
+        if S <= 0 or T_ <= 0 or W0 <= 0:
+            continue
+        a_loc, Ts = lax.fori_loop(k0, k1s, super_step, (a_loc, Ts))
+
+    return a_loc, Ts
+
+
+def dist_he2hb(data, Nt: int, grid: Grid, n: int | None = None,
+               sb: int | None = None):
+    """Reduce the cyclic storage of a Hermitian (lower-stored) matrix to
+    band form in place: diagonal tiles hold the band diagonal blocks, tile
+    (k+1, k) holds R (upper triangle; band subdiagonal block) over the
+    Householder panel V (strictly below), matching the dense he2hb packing.
+
+    Returns (data, Ts[K, nb, nb]) with K = Nt - 1 block-reflector
+    triangles, replicated."""
+    mtl = data.shape[0] // grid.p
+    ntl = data.shape[1] // grid.q
+    nb = data.shape[-1]
+    n = n if n is not None else Nt * nb
+    K = Nt - 1
+    sb = sb if sb is not None else superblock(max(K, 1))
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a: _he2hb_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
+        mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P()))
+    return fn(data)
+
+
+def v_from_gathered(full, b, lim):
+    """Unit-lower reflector block V from a gathered flat panel ``full``
+    [N, nb]: active rows [b, lim), unit diagonal starting at row b.
+
+    Shared by every descending panel applier (unmtr_he2hb / unmbr_ge2tb):
+    roll the active rows to the top, zero the dead tail, extract the unit
+    lower trapezoid, roll back, and mask to [b, lim)."""
+    N = full.shape[0]
+    rows_el = jnp.arange(N)
+    rolled = jnp.roll(full, -b, axis=0)
+    live = rows_el < (lim - b)
+    rolled = jnp.where(live[:, None], rolled, jnp.zeros_like(rolled))
+    v = jnp.roll(unit_lower(rolled), b, axis=0)
+    return jnp.where(((rows_el >= b) & (rows_el < lim))[:, None], v,
+                     jnp.zeros_like(v))
+
+
+def larfb_left_local(z_loc, Vt, Tk, gi_all):
+    """One distributed larfb: Z -= V Tk (V^H Z) with V replicated in tile
+    form [*, nb, nb] and Z's rows sharded over AXIS_P (one psum)."""
+    Vr = Vt[gi_all]
+    G = lax.psum(jnp.einsum('iab,ijac->jbc', jnp.conj(Vr), z_loc), AXIS_P)
+    TG = jnp.einsum('ab,jbc->jac', Tk, G)
+    return z_loc - jnp.einsum('iab,jbc->ijac', Vr, TG)
+
+
+def _unmtr_local(a_loc, z_loc, Ts, Nt: int, n: int, p: int, q: int,
+                 mtl: int):
+    """Z <- Q1 Z with Q1 the he2hb panel product (ref: src/unmtr_he2hb.cc):
+    panels applied descending; V gathered per panel, the larfb update is
+    one psum over the row axis + local MXU gemms on each rank's Z tiles."""
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    nb = a_loc.shape[-1]
+    K = Nt - 1
+    gi_all = r + p * jnp.arange(mtl)
+
+    def body(i, z_loc):
+        k = K - 1 - i
+        gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+        v = v_from_gathered(gpan.reshape(p * mtl * nb, nb), (k + 1) * nb, n)
+        Vt = v.reshape(p * mtl, nb, nb)
+        Tk = lax.dynamic_index_in_dim(Ts, k, axis=0, keepdims=False)
+        return larfb_left_local(z_loc, Vt, Tk, gi_all)
+
+    if K <= 0:
+        return z_loc
+    return lax.fori_loop(0, K, body, z_loc)
+
+
+def dist_unmtr_he2hb(a_data, Ts, z_data, Nt: int, grid: Grid,
+                     n: int | None = None):
+    """Apply the he2hb Q1 to a mesh-distributed Z (cyclic tile storage)."""
+    mtl = a_data.shape[0] // grid.p
+    nb = a_data.shape[-1]
+    n = n if n is not None else Nt * nb
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(
+        lambda a, z, t: _unmtr_local(a, z, t, Nt, n, grid.p, grid.q, mtl),
+        mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
+    return fn(a_data, z_data, Ts)

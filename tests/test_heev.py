@@ -61,6 +61,58 @@ def test_heev_mesh(rng):
     np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
 
 
+@pytest.mark.slow
+def test_heev_mesh_2x4_complex_ragged(rng):
+    # distributed stage 1 (dist_he2hb): ragged last tile, complex, vectors
+    n, nb = 37, 5
+    g = st.Grid(2, 4, devices=jax.devices()[:8])
+    a = herm(rng, n, np.complex128)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    w, Z = st.heev(A)
+    w, z = np.asarray(w), Z.to_numpy()
+    np.testing.assert_allclose(z.conj().T @ z, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-9)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-9)
+
+
+@pytest.mark.slow
+def test_heev_vals_mesh(rng):
+    n, nb = 24, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    w = st.heev_vals(A)
+    np.testing.assert_allclose(np.sort(np.asarray(w)),
+                               np.linalg.eigvalsh(a), atol=1e-10)
+
+
+@pytest.mark.slow
+def test_heev_mesh_trans_view_complex(rng):
+    # Trans view of a complex Hermitian is conj(A) != A: the mesh path must
+    # densify (zero-copy would silently factor A instead)
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = herm(rng, n, np.complex128)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    At = A.transpose()
+    w, Z = st.heev(At)
+    w, z = np.asarray(w), Z.to_numpy()
+    at = a.T
+    np.testing.assert_allclose(at @ z, z @ np.diag(w), atol=1e-10)
+
+
+@pytest.mark.slow
+def test_heev_mesh_upper_view(rng):
+    # Upper-stored input exercises the mesh fallback normalisation
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Upper, g)
+    w = st.heev_vals(A)
+    np.testing.assert_allclose(np.sort(np.asarray(w)),
+                               np.linalg.eigvalsh(a), atol=1e-10)
+
+
 def test_hegv(rng):
     n, nb = 12, 4
     a = herm(rng, n)

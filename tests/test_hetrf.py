@@ -97,3 +97,20 @@ def test_hesv_moderate_n(rng):
     resid = np.linalg.norm(a @ X.to_numpy() - b) / (
         np.linalg.norm(a) * np.linalg.norm(X.to_numpy()))
     assert resid < 1e-13
+
+
+def test_hesv_zero_offdiag_block(rng):
+    """Block-diagonal matrix: the panel R is exactly zero, every pivot
+    contest ties at 0 — pivots must stay within the live rows (a pad-row
+    pick would leak an out-of-range index into piv)."""
+    n, nb = 10, 4
+    d1 = herm_indef(rng, 6)
+    d2 = herm_indef(rng, 4)
+    a = np.zeros((n, n))
+    a[:6, :6] = d1
+    a[6:, 6:] = d2
+    b = rng.standard_normal((n, 2))
+    F, X = st.hesv(st.SymmetricMatrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb, nb))
+    assert int(np.max(np.asarray(F.piv))) < n
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-8)

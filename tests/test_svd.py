@@ -58,8 +58,8 @@ def test_svd_complex(rng):
 
 @pytest.mark.slow
 def test_svd_mesh_grid(rng):
-    # distributed storage in, gathered two-stage reduction (ref svd.cc
-    # gathers the band the same way, ge2tbGather)
+    # distributed stage 1 (dist_ge2tb); only the band is gathered for
+    # stage 2 (ref svd.cc ge2tbGather)
     m = n = 16
     g = st.make_grid(4)
     a = _mat(rng, m, n)
@@ -67,3 +67,32 @@ def test_svd_mesh_grid(rng):
     s = st.svd_vals(A)
     np.testing.assert_allclose(np.asarray(s),
                                np.linalg.svd(a, compute_uv=False), atol=1e-10)
+
+
+@pytest.mark.slow
+def test_svd_mesh_vectors_rect_ragged(rng):
+    import jax
+    m, n, nb = 37, 23, 5
+    g = st.Grid(2, 4, devices=jax.devices()[:8])
+    a = _mat(rng, m, n)
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    s, U, V = st.svd(A)
+    s = np.asarray(s)
+    u, v = U.to_numpy(), V.to_numpy()
+    np.testing.assert_allclose(u.conj().T @ u, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(v.conj().T @ v, np.eye(n), atol=1e-10)
+    np.testing.assert_allclose(u * s[None, :] @ v.conj().T, a, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_svd_mesh_complex(rng):
+    import jax
+    m, n, nb = 24, 24, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = (rng.standard_normal((m, n))
+         + 1j * rng.standard_normal((m, n))).astype(np.complex128)
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    s, U, V = st.svd(A)
+    s = np.asarray(s)
+    u, v = U.to_numpy(), V.to_numpy()
+    np.testing.assert_allclose(u * s[None, :] @ v.conj().T, a, atol=1e-9)
