@@ -15,7 +15,7 @@ from .version import __version__, id, version  # noqa: F401
 from .types import Diag, Layout, Norm, Op, Side, TileKind, Uplo  # noqa: F401
 from .options import (  # noqa: F401
     GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm, MethodHemm,
-    MethodLU, MethodTrsm, NormScope, Option, Target,
+    MethodLU, MethodSvd, MethodTrsm, NormScope, Option, Target,
 )
 from .exceptions import (  # noqa: F401
     SlateError, SlateNotConvergedError, SlateNotPositiveDefiniteError,
@@ -49,11 +49,13 @@ from .drivers.band import (  # noqa: F401
     GBFactors, PBFactors, gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf,
     pbtrs, tbsm,
 )
-from .drivers.heev import heev, heev_vals, heevd, hegst, hegv  # noqa: F401
+from .drivers.heev import (  # noqa: F401
+    heev, heev_vals, heevd, hegst, hegv, hb2st, steqr, sterf,
+)
 from .drivers.printing import format_matrix, print_matrix  # noqa: F401
 from .drivers.condest import gecondest, norm1est, trcondest  # noqa: F401
 from .drivers.hetrf import HEFactors, hesv, hetrf, hetrs  # noqa: F401
-from .drivers.svd import svd, svd_vals  # noqa: F401
+from .drivers.svd import bdsqr, svd, svd_vals, tb2bd  # noqa: F401
 from .drivers.mixed import (  # noqa: F401
     MixedResult, gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,
 )

@@ -14,96 +14,103 @@ from __future__ import annotations
 import numpy as np
 
 from ..core.matrix import HermitianMatrix, Matrix
+from ..options import Option, get_option
 from ..types import Uplo
 
 
-def _nb(n: int) -> int:
+def _nb(n: int, opts=None) -> int:
+    """Tile size: Option.BlockSize when given (ref: enums.hh:72
+    'block size, >= 1' — the nb the LAPACK/ScaLAPACK tiers pass through),
+    else a size heuristic."""
+    bs = get_option(opts, Option.BlockSize)
+    if bs:
+        return int(bs)
     return max(8, min(256, 1 << max(3, (n // 4).bit_length())))
 
 
-def _mat(a, nb=None) -> Matrix:
+def _mat(a, nb=None, opts=None) -> Matrix:
     a = np.asarray(a)
-    nb = nb or _nb(max(a.shape))
+    nb = nb or _nb(max(a.shape), opts)
     return Matrix.from_numpy(a, min(nb, a.shape[0]), min(nb, a.shape[1]))
 
 
-def gesv(a, b):
+def gesv(a, b, opts=None):
     """Solve A X = B (LAPACK dgesv).  Returns (x, perm)."""
     from ..drivers.lu import gesv as _gesv
-    F, X = _gesv(_mat(a), _mat(b))
+    F, X = _gesv(_mat(a, opts=opts), _mat(b, opts=opts), opts)
     return np.asarray(X.to_numpy()), np.asarray(F.perm)
 
 
-def getrf(a):
+def getrf(a, opts=None):
     """LU factor (LAPACK dgetrf).  Returns (lu, perm) with A[perm] = L U."""
     from ..drivers.lu import getrf as _getrf
-    F = _getrf(_mat(a))
+    F = _getrf(_mat(a, opts=opts), opts)
     return np.asarray(F.LU.to_numpy()), np.asarray(F.perm)
 
 
-def posv(a, b, uplo: str = "L"):
+def posv(a, b, uplo: str = "L", opts=None):
     """Solve A X = B, A Hermitian positive definite (LAPACK dposv).
     Returns x."""
     from ..drivers.cholesky import posv as _posv
-    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
                                    uplo=Uplo.Lower if uplo.upper() == "L"
                                    else Uplo.Upper)
-    _, X = _posv(A, _mat(b))
+    _, X = _posv(A, _mat(b, opts=opts), opts)
     return np.asarray(X.to_numpy())
 
 
-def potrf(a, uplo: str = "L"):
+def potrf(a, uplo: str = "L", opts=None):
     """Cholesky factor (LAPACK dpotrf).  Returns the triangular factor."""
     from ..drivers.cholesky import potrf as _potrf
-    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
                                    uplo=Uplo.Lower if uplo.upper() == "L"
                                    else Uplo.Upper)
-    return np.asarray(_potrf(A).to_numpy())
+    return np.asarray(_potrf(A, opts).to_numpy())
 
 
-def gels(a, b):
+def gels(a, b, opts=None):
     """Least squares min ||A X - B|| (LAPACK dgels).  Returns x."""
     from ..drivers.qr import gels as _gels
-    return np.asarray(_gels(_mat(a), _mat(b)).to_numpy())
+    return np.asarray(_gels(_mat(a, opts=opts), _mat(b, opts=opts), opts).to_numpy())
 
 
-def geqrf(a):
+def geqrf(a, opts=None):
     """QR factor (LAPACK dgeqrf).  Returns the packed QR Matrix factors."""
     from ..drivers.qr import geqrf as _geqrf
-    return _geqrf(_mat(a))
+    return _geqrf(_mat(a, opts=opts), opts)
 
 
-def heev(a, uplo: str = "L"):
+def heev(a, uplo: str = "L", opts=None):
     """Hermitian eigendecomposition (LAPACK dsyev/zheev).
     Returns (eigenvalues, eigenvectors)."""
     from ..drivers.heev import heev as _heev
-    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a)),
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
                                    uplo=Uplo.Lower if uplo.upper() == "L"
                                    else Uplo.Upper)
-    lam, Z = _heev(A)
+    lam, Z = _heev(A, opts)
     return np.asarray(lam), np.asarray(Z.to_numpy())
 
 
-def gesvd(a):
+def gesvd(a, opts=None):
     """SVD (LAPACK dgesvd).  Returns (u, s, vh)."""
     from ..drivers.svd import svd as _svd
-    s, U, V = _svd(_mat(a))
+    s, U, V = _svd(_mat(a, opts=opts), opts)
     return (np.asarray(U.to_numpy()), np.asarray(s),
             np.conj(np.asarray(V.to_numpy())).T)
 
 
-def gesvd_vals(a):
+def gesvd_vals(a, opts=None):
     """Singular values only."""
     from ..drivers.svd import svd_vals as _svd_vals
-    return np.asarray(_svd_vals(_mat(a)))
+    return np.asarray(_svd_vals(_mat(a, opts=opts), opts))
 
 
-def gecon(a):
+def gecon(a, opts=None):
     """Reciprocal 1-norm condition estimate via the Higham/Hager
     estimator (LAPACK dgecon analog)."""
     from ..drivers.auxiliary import norm as _norm
     from ..drivers.condest import gecondest
     from ..drivers.lu import getrf as _getrf
     from ..types import Norm
-    A = _mat(a)
-    return float(gecondest(_getrf(A), _norm(Norm.One, A)))
+    A = _mat(a, opts=opts)
+    return float(gecondest(_getrf(A, opts), _norm(Norm.One, A)))

@@ -29,6 +29,7 @@ from ..internal.band import (band_transpose, banded_trsm_lower,
                              pbtrs_banded)
 from ..options import Options
 from ..types import Diag, Op, Side, Uplo
+from ..util.trace import annotate
 
 
 def _block_width(nb: int, band: int) -> int:
@@ -99,6 +100,7 @@ def _wrap_like(x, Bm, n):
 
 # ------------------------------------------------------------- pb chain
 
+@annotate("slate.pbtrf")
 def pbtrf(A: HermitianBandMatrix, opts: Options | None = None) -> PBFactors:
     """Band Cholesky A = L L^H (ref: src/pbtrf.cc)."""
     slate_error(isinstance(A, HermitianBandMatrix),
@@ -116,6 +118,7 @@ def pbtrf(A: HermitianBandMatrix, opts: Options | None = None) -> PBFactors:
     return PBFactors(lband, kd, n, w)
 
 
+@annotate("slate.pbtrs")
 def pbtrs(F: PBFactors, B, opts: Options | None = None):
     """Solve from pbtrf factors (ref: src/pbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
@@ -123,6 +126,7 @@ def pbtrs(F: PBFactors, B, opts: Options | None = None):
     return _wrap_like(x, Bm, F.n)
 
 
+@annotate("slate.pbsv")
 def pbsv(A: HermitianBandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A Hermitian positive-definite band (ref: src/pbsv.cc).
     Returns (PBFactors, X)."""
@@ -132,6 +136,7 @@ def pbsv(A: HermitianBandMatrix, B, opts: Options | None = None):
 
 # ------------------------------------------------------------- gb chain
 
+@annotate("slate.gbtrf")
 def gbtrf(A: BandMatrix, opts: Options | None = None) -> GBFactors:
     """Band LU with partial pivoting (ref: src/gbtrf.cc).  Pivoting is
     bounded within kl rows below the diagonal, so the factorization runs as
@@ -152,6 +157,7 @@ def gbtrf(A: BandMatrix, opts: Options | None = None) -> GBFactors:
     return GBFactors(lu, perms, kl, ku, n, w)
 
 
+@annotate("slate.gbtrs")
 def gbtrs(F: GBFactors, B, opts: Options | None = None):
     """Solve from gbtrf factors (ref: src/gbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
@@ -159,6 +165,7 @@ def gbtrs(F: GBFactors, B, opts: Options | None = None):
     return _wrap_like(x, Bm, F.n)
 
 
+@annotate("slate.gbsv")
 def gbsv(A: BandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A general band (ref: src/gbsv.cc).
     Returns (GBFactors, X)."""
@@ -168,6 +175,7 @@ def gbsv(A: BandMatrix, B, opts: Options | None = None):
 
 # ------------------------------------------------------------- tbsm
 
+@annotate("slate.tbsm")
 def tbsm(side, alpha, A: TriangularBandMatrix, B,
          opts: Options | None = None):
     """Triangular band solve op(A) X = alpha B (Left) or X op(A) = alpha B
@@ -232,6 +240,7 @@ def _tbsm_left(A: TriangularBandMatrix, alpha, b, extra_op: Op):
 
 # ------------------------------------------------------------- band multiply
 
+@annotate("slate.gbmm")
 def gbmm(alpha, A: BandMatrix, B, beta=0.0, C=None,
          opts: Options | None = None):
     """C = alpha op(A) B + beta C with A band (ref: src/gbmm.cc)."""
@@ -249,6 +258,7 @@ def gbmm(alpha, A: BandMatrix, B, beta=0.0, C=None,
     return _wrap_like(out, Bm if Bm is not None else C, m)
 
 
+@annotate("slate.hbmm")
 def hbmm(side, alpha, A: HermitianBandMatrix, B, beta=0.0, C=None,
          opts: Options | None = None):
     """C = alpha A B + beta C with A Hermitian band (ref: src/hbmm.cc).

@@ -26,6 +26,7 @@ from ..options import (MethodGemm, Option, Options, Target,
                        resolve_target, select_gemm_method)
 from ..parallel import summa
 from ..types import Diag, Op, Side, Uplo
+from ..util.trace import annotate
 
 
 def as_root_general(A: BaseMatrix, mb: int | None = None,
@@ -52,6 +53,7 @@ def _result_mat(C: BaseMatrix, data) -> Matrix:
 
 # ---------------------------------------------------------------- gemm
 
+@annotate("slate.gemm")
 def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
          C: Matrix | None = None, opts: Options | None = None) -> Matrix:
     """C = alpha op(A) op(B) + beta C (ref: src/gemm.cc:66-89 dispatch,
@@ -103,6 +105,7 @@ def _side(side) -> Side:
 
 # ---------------------------------------------------------------- trsm/trmm
 
+@annotate("slate.trsm")
 def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     """Solve op(A) X = alpha B (Left) or X op(A) = alpha B (Right), A
     triangular (ref: src/trsm.cc method dispatch -> src/trsmB.cc ->
@@ -110,8 +113,13 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
 
     single: one XLA triangular_solve (blocked internally, MXU-shaped).
     mesh: parallel.dist_trsm substitution pipeline with panel broadcasts.
+    MethodTrsm picks the anchor grid when A and B live on different grids:
+    trsmB (default) moves A's triangle onto B's grid, trsmA keeps A
+    stationary and redistributes B onto A's grid — the reference's
+    stationary-operand distinction (ref: src/trsmA.cc vs src/trsmB.cc).
     """
     from ..core.matrix import BaseTrapezoidMatrix
+    from ..options import MethodTrsm, select_trsm_method
     from ..parallel.dist_trsm import dist_trsm_left, dist_trsm_right
     sd = _side(side)
     slate_error(isinstance(A, BaseTrapezoidMatrix), "trsm: A not triangular")
@@ -124,22 +132,25 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     unit = A.diag is Diag.Unit
 
     if target is Target.mesh and B.grid.mesh is not None:
+        meth = select_trsm_method(opts, B.nt)
+        grid = A.grid if (meth is MethodTrsm.trsmA
+                          and A.grid.mesh is not None) else B.grid
         lower = A.uplo is Uplo.Lower       # storage triangle
         nb = A.storage.nb
-        An = _root_storage_triangular(A, grid=B.grid)
+        An = _root_storage_triangular(A, grid=grid)
         if sd is Side.Right:
             # direct column-substitution kernel: no dense transpose
-            Bn = as_root_general(B, None, nb, grid=B.grid)
+            Bn = as_root_general(B, None, nb, grid=grid)
             data = dist_trsm_right(An.storage.data, Bn.storage.data,
                                    jnp.asarray(alpha, Bn.dtype),
-                                   Nt=An.storage.Nt, grid=B.grid,
+                                   Nt=An.storage.Nt, grid=grid,
                                    lower=lower, op_a=A.op, unit_diag=unit,
                                    n=An.storage.n)
         else:
-            Bn = as_root_general(B, nb, None, grid=B.grid)
+            Bn = as_root_general(B, nb, None, grid=grid)
             data = dist_trsm_left(An.storage.data, Bn.storage.data,
                                   jnp.asarray(alpha, Bn.dtype),
-                                  Nt=An.storage.Nt, grid=B.grid, lower=lower,
+                                  Nt=An.storage.Nt, grid=grid, lower=lower,
                                   op_a=A.op, unit_diag=unit, n=An.storage.n)
         st = Bn.storage
         return Matrix(TileStorage(data, st.m, st.n, st.mb, st.nb, st.grid))
@@ -167,6 +178,7 @@ def _root_storage_triangular(A, grid=None):
     return Matrix(TileStorage.from_dense(d, nb, nb, grid))
 
 
+@annotate("slate.trmm")
 def trmm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
     """B = alpha op(A) B (Left) or alpha B op(A) (Right), A triangular
     (ref: src/trmm.cc -> work/work_trmm.cc).
@@ -238,6 +250,7 @@ def _rank_k_mesh(alpha, A, beta, C, opts, conj: bool, B=None, alpha2=None):
     return _result_mat(C, data)
 
 
+@annotate("slate.herk")
 def herk(alpha, A, beta, C, opts: Options | None = None):
     """C = alpha A A^H + beta C, C Hermitian (ref: src/herk.cc,
     internal_herk.cc:843).  mesh: triangle-aware, half-gemm cost."""
@@ -251,6 +264,7 @@ def herk(alpha, A, beta, C, opts: Options | None = None):
     return HermitianMatrix._from_view(out, C._uplo_logical())
 
 
+@annotate("slate.syrk")
 def syrk(alpha, A, beta, C, opts: Options | None = None):
     """C = alpha A A^T + beta C, C symmetric (ref: src/syrk.cc)."""
     from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
@@ -262,6 +276,7 @@ def syrk(alpha, A, beta, C, opts: Options | None = None):
     return SymmetricMatrix._from_view(out, C._uplo_logical())
 
 
+@annotate("slate.her2k")
 def her2k(alpha, A, B, beta, C, opts: Options | None = None):
     """C = alpha A B^H + conj(alpha) B A^H + beta C (ref: src/her2k.cc,
     internal_her2k.cc:1062).  mesh: one triangle-aware pass."""
@@ -277,6 +292,7 @@ def her2k(alpha, A, B, beta, C, opts: Options | None = None):
     return HermitianMatrix._from_view(out, C._uplo_logical())
 
 
+@annotate("slate.syr2k")
 def syr2k(alpha, A, B, beta, C, opts: Options | None = None):
     """C = alpha A B^T + alpha B A^T + beta C (ref: src/syr2k.cc)."""
     from ..core.matrix import BaseTrapezoidMatrix, SymmetricMatrix
@@ -290,11 +306,35 @@ def syr2k(alpha, A, B, beta, C, opts: Options | None = None):
     return SymmetricMatrix._from_view(out, C._uplo_logical())
 
 
+@annotate("slate.hemm")
 def hemm(side, alpha, A, B, beta=0.0, C=None, opts=None) -> Matrix:
-    """C = alpha A B + beta C with A Hermitian (ref: src/hemm.cc,
-    hemmA variant src/hemmA.cc).  A.to_dense() expands the stored triangle,
-    then the multiply rides gemm (SUMMA on mesh)."""
+    """C = alpha A B + beta C with A Hermitian (ref: src/hemm.cc method
+    dispatch, hemmA variant src/hemmA.cc).  A.to_dense() expands the stored
+    triangle, then the multiply rides gemm (SUMMA on mesh); MethodHemm
+    selects the stationary-A comm pattern (hemmA) explicitly or by the
+    single-block-column heuristic (ref: method.hh MethodHemm::select_algo)."""
+    from ..options import MethodHemm, get_option
     sd = _side(side)
+    meth = get_option(opts, Option.MethodHemm)
+    if meth is MethodHemm.Auto and sd is Side.Left and B.nt < 2:
+        meth = MethodHemm.hemmA
+    if meth is MethodHemm.hemmA and sd is Side.Left:
+        o = dict(opts or {})
+        o[Option.MethodGemm] = MethodGemm.gemmA
+        return gemm(alpha, A, B, beta, C, o)
+    if meth is MethodHemm.hemmA and sd is Side.Right:
+        # honor the stationary-A request on the Right via the Hermitian
+        # identity alpha B A = (conj(alpha) A B^H)^H — a left hemmA on B^H
+        # followed by one elementwise add (never silently ignored)
+        from .auxiliary import add as _add
+        G = hemm(Side.Left, jnp.conj(jnp.asarray(alpha)), A,
+                 B.conj_transpose(), 0.0, None,
+                 {**(opts or {}), Option.MethodHemm: MethodHemm.hemmA})
+        if C is None:
+            dtc = jnp.result_type(A.dtype, B.dtype)
+            C = Matrix.zeros(B.m, A.n, B.mb, A.nb, B.grid, dtc)
+            beta = 0.0
+        return _add(1.0, G.conj_transpose(), beta, C)
     if sd is Side.Left:
         return gemm(alpha, A, B, beta, C, opts)
     return gemm(alpha, B, A, beta, C, opts)

@@ -14,6 +14,9 @@ over the 2D block-cyclic grid.
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -21,11 +24,12 @@ from ..core.matrix import (BaseTrapezoidMatrix, HermitianMatrix, Matrix,
                            SymmetricMatrix, TriangularMatrix)
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
-from ..options import Options, Target, resolve_target
-from ..parallel.dist_chol import dist_potrf
+from ..options import Option, Options, Target, get_option, resolve_target
+from ..parallel.dist_chol import SUPERBLOCKS, dist_potrf, superblock
 from ..types import Diag, Op, Uplo
 from .blas3 import as_root_general, trsm
 from ..internal.potrf import potrf_tile
+from ..util.trace import annotate
 
 
 def _potrf_dense_blocked(a, nb: int):
@@ -44,6 +48,7 @@ def _potrf_dense_blocked(a, nb: int):
     return a
 
 
+@annotate("slate.potrf")
 def potrf(A, opts: Options | None = None) -> TriangularMatrix:
     """Factor A = L L^H (Lower) or A = U^H U (Upper); returns the triangular
     factor (ref: src/potrf.cc)."""
@@ -64,7 +69,13 @@ def potrf(A, opts: Options | None = None) -> TriangularMatrix:
         else:
             full = A.to_dense()
             st_l = TileStorage.from_dense(full, nb, nb, A.grid)
-        out = dist_potrf(st_l.data, st_l.Nt, A.grid, n=st_l.n)
+        # Option.Lookahead scales the unrolled-superblock count: more
+        # lookahead = more statically visible k steps for XLA to pipeline
+        # across (the analog of the reference's lookahead task depth,
+        # potrf.cc:266-287), at proportional compile-time cost
+        la = max(1, int(get_option(opts, Option.Lookahead)))
+        out = dist_potrf(st_l.data, st_l.Nt, A.grid, n=st_l.n,
+                         sb=superblock(st_l.Nt, SUPERBLOCKS * la))
         st_out = TileStorage(out, st_l.m, st_l.n, nb, nb, A.grid)
         L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)
         return L.conj_transpose() if uplo is Uplo.Upper else L
@@ -76,6 +87,7 @@ def potrf(A, opts: Options | None = None) -> TriangularMatrix:
     return L.conj_transpose() if uplo is Uplo.Upper else L
 
 
+@annotate("slate.potrs")
 def potrs(L: TriangularMatrix, B, opts: Options | None = None) -> Matrix:
     """Solve with the Cholesky factor: two triangular sweeps
     (ref: src/potrs.cc)."""
@@ -87,14 +99,37 @@ def potrs(L: TriangularMatrix, B, opts: Options | None = None) -> Matrix:
     return trsm("l", 1.0, L, Y, opts)
 
 
+@annotate("slate.posv")
 def posv(A, B, opts: Options | None = None):
     """Solve A X = B for Hermitian positive definite A
-    (ref: src/posv.cc).  Returns (L, X)."""
+    (ref: src/posv.cc).  Returns (L, X).
+
+    Option.HoldLocalWorkspace fuses factor+solve into ONE jitted program
+    so the factor's workspace stays live on device between the phases —
+    the XLA analog of the reference's held workspace tiles
+    (ref: potrf.cc:169 passing HoldLocalWorkspace into potrs)."""
+    if get_option(opts, Option.HoldLocalWorkspace):
+        key = (tuple(sorted(opts.items(), key=lambda kv: kv[0].value))
+               if opts else ())
+        return _fused_posv(key)(A, B)
+    return _posv_body(A, B, opts)
+
+
+def _posv_body(A, B, opts):
     L = potrf(A, opts)
     X = potrs(L, B, opts)
     return L, X
 
 
+@functools.lru_cache(maxsize=32)
+def _fused_posv(opts_items):
+    """One cached jitted factor+solve program per distinct opts — a fresh
+    jit per call would retrace and recompile every invocation."""
+    opts = dict(opts_items) if opts_items else None
+    return jax.jit(lambda A, B: _posv_body(A, B, opts))
+
+
+@annotate("slate.potri")
 def potri(L: TriangularMatrix, opts: Options | None = None):
     """Inverse from Cholesky factor: A^{-1} = L^-H L^-1
     (ref: src/potri.cc = trtri + trtrm).  Returns a HermitianMatrix."""

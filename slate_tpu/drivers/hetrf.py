@@ -33,6 +33,7 @@ from ..internal.band import gbtrf_banded, gbtrs_banded
 from ..internal.getrf import panel_lu
 from ..options import Options
 from ..types import is_complex
+from ..util.trace import annotate
 
 
 class HEFactors(NamedTuple):
@@ -155,6 +156,7 @@ def _aasen_blocked(a, nb: int):
     return L[:n0, :n0], Tdiag, Tsub, piv[:n0]
 
 
+@annotate("slate.hetrf")
 def hetrf(A, opts: Options | None = None) -> HEFactors:
     """Blocked Aasen factorization of a Hermitian indefinite matrix
     (ref: src/hetrf.cc).  Returns HEFactors; T has bandwidth A.nb.
@@ -208,6 +210,7 @@ def _packed_band_T(Tdiag, Tsub, nb: int, n0: int, kd: int):
     return jnp.where(valid, out, jnp.zeros((), dt))
 
 
+@annotate("slate.hetrs")
 def hetrs(F: HEFactors, B, opts: Options | None = None):
     """Solve from Aasen factors (ref: src/hetrs.cc):
     x = P^H L^-H T^-1 L^-1 P b.  T's band-LU factors come precomputed in
@@ -233,6 +236,7 @@ def hetrs(F: HEFactors, B, opts: Options | None = None):
     return x
 
 
+@annotate("slate.hesv")
 def hesv(A, B, opts: Options | None = None):
     """Solve A X = B for Hermitian indefinite A (ref: src/hesv.cc).
     Returns (HEFactors, X)."""

@@ -29,6 +29,7 @@ from ..options import (MethodLU, Option, Options, Target, get_option,
                        resolve_target, select_lu_method)
 from ..parallel.dist_lu import dist_getrf
 from ..types import Diag, Op, Uplo
+from ..util.trace import annotate
 from .blas3 import as_root_general, trsm
 
 
@@ -44,13 +45,19 @@ class LUFactors(NamedTuple):
         return TriangularMatrix._from_view(self.LU, Uplo.Upper)
 
 
-def _getrf_dense_blocked(a, nb: int, method: str):
+def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
+                         mpt: int = 4, depth: int = 2):
     """Blocked right-looking LU, statically-shaped panels (unrolled).
 
     Panel factor delegates to XLA's native pivoted LU (the analog of the
     reference's lapack panel kernel); trailing update is trsm + one MXU
-    gemm per panel (ref: getrf.cc:174-215 trailing task)."""
-    from ..internal.getrf import panel_lu, panel_lu_nopiv, panel_lu_tournament
+    gemm per panel (ref: getrf.cc:174-215 trailing task).  ``tau`` < 1
+    switches to threshold pivoting (Option.PivotThreshold); ``mpt``
+    (Option.MaxPanelThreads) sizes the tournament's independent row blocks
+    (the analog of panel threads) and ``depth`` (Option.Depth) its
+    reduction-tree fan-in."""
+    from ..internal.getrf import (panel_lu, panel_lu_nopiv,
+                                  panel_lu_threshold, panel_lu_tournament)
     m, n = a.shape
     kmax = min(m, n)
     perm_g = jnp.arange(m)
@@ -61,7 +68,10 @@ def _getrf_dense_blocked(a, nb: int, method: str):
         if method == "nopiv":
             lu, perm = panel_lu_nopiv(pan)
         elif method == "tntpiv":
-            lu, perm = panel_lu_tournament(pan, block_rows=4 * nb)
+            lu, perm = panel_lu_tournament(pan, block_rows=mpt * nb,
+                                           arity=depth)
+        elif tau < 1.0:
+            lu, perm = panel_lu_threshold(pan, tau)
         else:
             lu, perm = panel_lu(pan)
         a = a.at[k0:, k0:k1].set(lu)
@@ -81,16 +91,19 @@ def _getrf_dense_blocked(a, nb: int, method: str):
     return a, perm_g
 
 
+@annotate("slate.getrf")
 def getrf(A: Matrix, opts: Options | None = None) -> LUFactors:
     """LU with partial pivoting (ref: src/getrf.cc)."""
     return _getrf(A, opts, "partial")
 
 
+@annotate("slate.getrf_nopiv")
 def getrf_nopiv(A: Matrix, opts: Options | None = None) -> LUFactors:
     """LU without pivoting (ref: src/getrf_nopiv.cc)."""
     return _getrf(A, opts, "nopiv")
 
 
+@annotate("slate.getrf_tntpiv")
 def getrf_tntpiv(A: Matrix, opts: Options | None = None) -> LUFactors:
     """CALU tournament-pivoting LU (ref: src/getrf_tntpiv.cc)."""
     return _getrf(A, opts, "tntpiv")
@@ -99,13 +112,20 @@ def getrf_tntpiv(A: Matrix, opts: Options | None = None) -> LUFactors:
 def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
     target = resolve_target(opts, A)
     nb = A.nb
+    tau = float(get_option(opts, Option.PivotThreshold))
+    mpt = int(get_option(opts, Option.MaxPanelThreads))
+    depth = int(get_option(opts, Option.Depth))
 
     if target is Target.mesh and A.grid.mesh is not None:
+        from ..parallel.dist_chol import SUPERBLOCKS, superblock
         slate_error(A.m == A.n, "mesh getrf: square matrices (gesv path)")
         An = as_root_general(A, nb, nb, grid=A.grid)
         st = An.storage
+        la = max(1, int(get_option(opts, Option.Lookahead)))
         data, perm = dist_getrf(st.data, st.Nt, A.grid, st.n, method,
-                                ib=get_option(opts, Option.InnerBlocking))
+                                ib=get_option(opts, Option.InnerBlocking),
+                                sb=superblock(st.Nt, SUPERBLOCKS * la),
+                                tau=tau, mpt=mpt, depth=depth)
         out = TileStorage(data, st.m, st.n, nb, nb, st.grid)
         # restore the pad-region-zero invariant (final ragged panel is
         # identity-augmented inside the factorization)
@@ -115,11 +135,13 @@ def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
         return LUFactors(Matrix(out), perm[: st.m])
 
     ad = A.to_dense()
-    lu, perm = _getrf_dense_blocked(ad, nb, method)
+    lu, perm = _getrf_dense_blocked(ad, nb, method, tau=tau, mpt=mpt,
+                                    depth=depth)
     st = TileStorage.from_dense(lu, nb, nb, A.grid)
     return LUFactors(Matrix(st), perm)
 
 
+@annotate("slate.getrs")
 def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
     """Solve with LU factors: X = U^-1 L^-1 B[perm] (ref: src/getrs.cc).
 
@@ -141,6 +163,7 @@ def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
     return trsm("l", 1.0, F.upper(), Y, opts)
 
 
+@annotate("slate.gesv")
 def gesv(A: Matrix, B, opts: Options | None = None):
     """Solve A X = B via LU (ref: src/gesv.cc; MethodLU dispatch).
     Returns (LUFactors, X)."""
@@ -161,6 +184,7 @@ def gesv_nopiv(A: Matrix, B, opts: Options | None = None):
     return F, getrs(F, B, opts)
 
 
+@annotate("slate.getri")
 def getri(F: LUFactors, opts: Options | None = None) -> Matrix:
     """In-place-style inverse from LU factors (ref: src/getri.cc):
     A^-1 = U^-1 L^-1 P."""
@@ -170,6 +194,7 @@ def getri(F: LUFactors, opts: Options | None = None) -> Matrix:
     return getrs(F, I, opts)
 
 
+@annotate("slate.getriOOP")
 def getriOOP(A: Matrix, opts: Options | None = None) -> Matrix:
     """Out-of-place inverse (ref: src/getriOOP.cc): factor + solve vs I."""
     F = getrf(A, opts)

@@ -37,6 +37,7 @@ from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
 from ..options import Option, Options, get_option
 from ..types import Norm, eps, lower_precision
+from ..util.trace import annotate
 from . import auxiliary as aux
 from .blas3 import gemm
 from .cholesky import potrf, potrs
@@ -64,7 +65,10 @@ def _refine(A: Matrix, B: Matrix, solve_lo, opts: Options | None):
     itermax = get_option(opts, Option.MaxIterations)
     n = A.m
     anorm = aux.norm(Norm.Inf, A)
-    tol = eps(A.dtype) * math.sqrt(n)
+    # Option.Tolerance overrides the eps*sqrt(n) default (ref: enums.hh
+    # Tolerance; gesv_mixed.cc cte)
+    t = get_option(opts, Option.Tolerance)
+    tol = t if t is not None else eps(A.dtype) * math.sqrt(n)
 
     x0 = solve_lo(B)
     r0 = _residual(A, x0, B, opts)
@@ -102,6 +106,7 @@ def _maybe_fallback(ok, x, fallback):
     return x, True
 
 
+@annotate("slate.gesv_mixed")
 def gesv_mixed(A: Matrix, B, opts: Options | None = None) -> MixedResult:
     """LU in low precision + IR to working precision
     (ref: src/gesv_mixed.cc)."""
@@ -119,6 +124,7 @@ def gesv_mixed(A: Matrix, B, opts: Options | None = None) -> MixedResult:
     return MixedResult(x, it, ok)
 
 
+@annotate("slate.posv_mixed")
 def posv_mixed(A: HermitianMatrix, B, opts: Options | None = None
                ) -> MixedResult:
     """Cholesky in low precision + IR (ref: src/posv_mixed.cc)."""
@@ -152,7 +158,8 @@ def _gmres_ir(A: Matrix, B: Matrix, solve_lo, opts: Options | None,
     n = A.m
     dt = A.dtype
     anorm = aux.norm(Norm.Inf, A)
-    tol = eps(dt) * math.sqrt(n)
+    t = get_option(opts, Option.Tolerance)
+    tol = t if t is not None else eps(dt) * math.sqrt(n)
     bd = B.to_dense()                         # skinny [n, nrhs]
     nrhs = bd.shape[1]
 
@@ -240,6 +247,7 @@ def _gmres_ir(A: Matrix, B: Matrix, solve_lo, opts: Options | None,
     return X, it, jnp.all(conv)
 
 
+@annotate("slate.gesv_mixed_gmres")
 def gesv_mixed_gmres(A: Matrix, B, opts: Options | None = None
                      ) -> MixedResult:
     """ref: src/gesv_mixed_gmres.cc"""
@@ -257,6 +265,7 @@ def gesv_mixed_gmres(A: Matrix, B, opts: Options | None = None
     return MixedResult(x, it, ok)
 
 
+@annotate("slate.posv_mixed_gmres")
 def posv_mixed_gmres(A: HermitianMatrix, B, opts: Options | None = None
                      ) -> MixedResult:
     """ref: src/posv_mixed_gmres.cc"""

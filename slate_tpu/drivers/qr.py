@@ -33,6 +33,7 @@ from ..internal.qr import (apply_q_left, apply_q_right, build_t,
 from ..options import (MethodGels, Options, Target,
                        resolve_target, select_gels_method)
 from ..types import Op, Side, Uplo, is_complex
+from ..util.trace import annotate
 from .blas3 import _dense_to_like, _side, gemm, herk, trsm
 from .cholesky import potrf
 
@@ -124,6 +125,7 @@ def _geqrf_dense_blocked(a, nb: int):
     return a, T_stack
 
 
+@annotate("slate.geqrf")
 def geqrf(A: Matrix, opts: Options | None = None) -> QRFactors:
     """QR factorization A = Q R (ref: src/geqrf.cc).  Returns packed factors;
     use :func:`unmqr` to apply Q and ``triu(R)`` for solves."""
@@ -145,6 +147,7 @@ def geqrf(A: Matrix, opts: Options | None = None) -> QRFactors:
     return QRFactors(Qm, T)
 
 
+@annotate("slate.gelqf")
 def gelqf(A: Matrix, opts: Options | None = None) -> LQFactors:
     """LQ factorization A = L Q via QR of A^H (ref: src/gelqf.cc computes the
     mirrored Householder chain; algebraically identical)."""
@@ -169,6 +172,7 @@ def _panel_ranges(m: int, n: int, nb: int):
     return [(k0, min(k0 + nb, r)) for k0 in range(0, r, nb)]
 
 
+@annotate("slate.unmqr")
 def unmqr(side, op, F: QRFactors, C, opts: Options | None = None) -> Matrix:
     """Multiply C by Q (op='n') or Q^H (op='c'/'t') from the given side
     (ref: src/unmqr.cc).  Q is the implicit factor from :func:`geqrf`."""
@@ -218,6 +222,7 @@ def _unmqr_caqr(sd: Side, conj_trans: bool, F: CAQRFactors, C,
     return Matrix(TileStorage(data, cs.m, cs.n, cs.mb, cs.nb, cs.grid))
 
 
+@annotate("slate.unmlq")
 def unmlq(side, op, F: LQFactors, C, opts: Options | None = None) -> Matrix:
     """Multiply C by the LQ factor Q = Qr^H (ref: src/unmlq.cc): flips op on
     the underlying QR reflectors."""
@@ -235,14 +240,28 @@ def qr_multiply(F: QRFactors):
 
 
 def _gram(A: Matrix, opts: Options | None):
-    """G = A^H A as a lower Hermitian matrix (shared by the CholQR paths)."""
+    """G = A^H A as a lower Hermitian matrix (shared by the CholQR paths).
+
+    MethodCholQR picks the accumulation (ref: method.hh:114-160): HerkC
+    (default) is the triangle-aware rank-k — half the flops; GemmC/GemmA
+    compute the full square via the corresponding gemm comm pattern."""
     from ..core.matrix import HermitianMatrix
+    from ..options import MethodCholQR, MethodGemm, Option, get_option
+    meth = get_option(opts, Option.MethodCholQR)
+    if meth in (MethodCholQR.GemmC, MethodCholQR.GemmA):
+        o = dict(opts or {})
+        o[Option.MethodGemm] = (MethodGemm.gemmA
+                                if meth is MethodCholQR.GemmA
+                                else MethodGemm.gemmC)
+        G = gemm(1.0, A.conj_transpose(), A, 0.0, None, o)
+        return HermitianMatrix._from_view(G, Uplo.Lower)
     return herk(1.0, A.conj_transpose(), 0.0,
                 HermitianMatrix._from_view(
                     Matrix.zeros(A.n, A.n, A.nb, A.nb, A.grid, A.dtype),
                     Uplo.Lower), opts)
 
 
+@annotate("slate.cholqr")
 def cholqr(A: Matrix, opts: Options | None = None):
     """Cholesky QR: G = A^H A, R = chol(G)^H, Q = A R^-1
     (ref: src/cholqr.cc).  Composes herk/potrf/trsm so the mesh path is the
@@ -255,6 +274,7 @@ def cholqr(A: Matrix, opts: Options | None = None):
     return Q, R
 
 
+@annotate("slate.gels_cholqr")
 def gels_cholqr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     """Least squares via the semi-normal equations R^H R x = A^H b with R
     from CholQR (ref: src/gels_cholqr.cc).  Mesh-distributed by
@@ -266,6 +286,7 @@ def gels_cholqr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     return trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
 
 
+@annotate("slate.gels_qr")
 def gels_qr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     """Least squares via Householder QR (ref: src/gels_qr.cc):
     min ||Ax - b||: x = R^-1 (Q^H b)[:n]."""
@@ -280,6 +301,7 @@ def gels_qr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     return X.with_dense(xd)
 
 
+@annotate("slate.gels")
 def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
     """Linear least squares / minimum-norm solve (ref: src/gels.cc:141):
 

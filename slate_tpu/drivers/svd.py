@@ -28,8 +28,10 @@ from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..internal.qr import (apply_q_left, apply_q_right, build_t,
                            householder_panel, householder_vec, phase_of)
-from ..options import Options, Target, resolve_target
+from ..options import (MethodSvd, Option, Options, Target, get_option,
+                       resolve_target)
 from ..types import Op, is_complex
+from ..util.trace import annotate
 
 
 # ---------------------------------------------------------------- stage 1
@@ -195,6 +197,49 @@ def _bd_svd(d, e, want_uv: bool):
     return jnp.linalg.svd(B, compute_uv=False), None, None
 
 
+def bdsqr(d, e):
+    """SVD of a real upper bidiagonal (d, e) as a public driver
+    (ref: src/bdsqr.cc wrapping lapack::bdsqr).  Returns (s, U, Vh)."""
+    return _bd_svd(jnp.asarray(d), jnp.asarray(e), True)
+
+
+@annotate("slate.tb2bd")
+def tb2bd(TB, *, want_uv: bool = True):
+    """Band -> bidiagonal bulge chase as a public driver
+    (ref: src/tb2bd.cc): takes a TriangularBandMatrix (upper), returns
+    (d, e, U2, V2) with band = U2 B V2^H."""
+    from ..core.matrix import TriangularBandMatrix
+    slate_error(isinstance(TB, TriangularBandMatrix),
+                "tb2bd: need TriangularBandMatrix")
+    return _tb2bd(TB.to_dense(), TB.kd, want_uv=want_uv)
+
+
+def _stage2_svd(band, nb: int, jobu: bool, opts: Options | None):
+    """Stage 2 + small-problem seam, method-dispatched (the MethodSvd
+    consumer).  Returns (s, Un, Vn) with band = Un diag(s) Vn^H
+    (Un/Vn None when jobu=False).
+
+    Auto: SVD the band DIRECTLY with XLA's svd — the tb2bd chase's
+    sequential scan is pure latency when the downstream kernel is O(n^3)
+    dense svd anyway (same reasoning as MethodEig.Auto; cf. ref svd.cc:286
+    where the chase feeds O(n^2) bdsqr, which does pay).
+    Bidiag: the parity route — tb2bd bulge chase to a true bidiagonal,
+    then the bdsqr-analog seam."""
+    meth = get_option(opts, Option.MethodSvd)
+    if meth is MethodSvd.Auto:
+        if jobu:
+            Ub, s, Vbh = jnp.linalg.svd(band)
+            return s, Ub, jnp.conj(Vbh).T
+        return jnp.linalg.svd(band, compute_uv=False), None, None
+    d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
+    s, Ub, Vbh = _bd_svd(d, e, jobu)
+    if not jobu:
+        return s, None, None
+    Un = U2 @ Ub.astype(U2.dtype)
+    Vn = V2 @ jnp.conj(Vbh.astype(V2.dtype)).T
+    return s, Un, Vn
+
+
 def _unmbr_ge2tb_u(a_packed, Tqs, nb: int, Z):
     """Z <- Q_qr Z (ref: unmbr_ge2tb U side): QR panels descending."""
     m = a_packed.shape[0]
@@ -230,6 +275,7 @@ def _unmbr_ge2tb_v(a_packed, Tls, nb: int, Z):
     return Z
 
 
+@annotate("slate.svd")
 def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
     """Singular value decomposition A = U diag(s) V^H (ref: src/svd.cc).
 
@@ -248,15 +294,13 @@ def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
     ad = A.to_dense()
     packed, Tqs, Tls = _ge2tb_dense(ad, nb)
     band = _band_upper_of(packed, n, nb)
-    d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
-    s, Ub, Vbh = _bd_svd(d, e, jobu)
+    s, Un, Vn = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
         return s, None, None
-    Un = U2 @ Ub.astype(U2.dtype)                      # [n, n]
-    Vn = V2 @ jnp.conj(Vbh.astype(V2.dtype)).T         # [n, n]
-    Ufull = jnp.zeros((m, n), packed.dtype).at[:n, :n].set(Un)
+    Ufull = jnp.zeros((m, n), packed.dtype).at[:n, :n].set(
+        Un.astype(packed.dtype))
     Ufull = _unmbr_ge2tb_u(packed, Tqs, nb, Ufull)
-    Vfull = _unmbr_ge2tb_v(packed, Tls, nb, Vn)
+    Vfull = _unmbr_ge2tb_v(packed, Tls, nb, Vn.astype(packed.dtype))
     g = A.grid
     Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
     Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
@@ -298,20 +342,34 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
         st_in = A.storage                        # zero-copy
     else:
         st_in = TileStorage.from_dense(A.to_dense(), nb, nb, grid)
-    data, Tqs, Tls = dist_ge2tb(st_in.data, st_in.Mt, st_in.Nt, m, n, grid)
+    from ..parallel.dist_chol import SUPERBLOCKS, superblock
+    la = max(1, int(get_option(opts, Option.Lookahead)))
+    data, Tqs, Tls = dist_ge2tb(st_in.data, st_in.Mt, st_in.Nt, m, n, grid,
+                                sb=superblock(max(st_in.Nt, 1),
+                                              SUPERBLOCKS * la))
     st_packed = TileStorage(data, m, n, nb, nb, grid)
     band = _band_upper_from_tiles(st_packed, n, nb)
-    d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
-    s, Ub, Vbh = _bd_svd(d, e, jobu)
-    if not jobu:
-        return s, None, None
-    U2m = Matrix(TileStorage.from_dense(U2, nb, nb, grid))
-    Ubm = Matrix(TileStorage.from_dense(Ub.astype(U2.dtype), nb, nb, grid))
-    Un = gemm(1.0, U2m, Ubm, opts=opts)          # [n, n] mesh product
-    V2m = Matrix(TileStorage.from_dense(V2, nb, nb, grid))
-    Vbm = Matrix(TileStorage.from_dense(
-        jnp.conj(Vbh.astype(V2.dtype)).T, nb, nb, grid))
-    Vn = gemm(1.0, V2m, Vbm, opts=opts)
+    meth = get_option(opts, Option.MethodSvd)
+    if meth is MethodSvd.Auto:
+        s, Uns, Vns = _stage2_svd(band, nb, jobu, opts)
+        if not jobu:
+            return s, None, None
+        dt = st_packed.dtype
+        Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
+        Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
+    else:
+        d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
+        s, Ub, Vbh = _bd_svd(d, e, jobu)
+        if not jobu:
+            return s, None, None
+        U2m = Matrix(TileStorage.from_dense(U2, nb, nb, grid))
+        Ubm = Matrix(TileStorage.from_dense(Ub.astype(U2.dtype), nb, nb,
+                                            grid))
+        Un = gemm(1.0, U2m, Ubm, opts=opts)      # [n, n] mesh product
+        V2m = Matrix(TileStorage.from_dense(V2, nb, nb, grid))
+        Vbm = Matrix(TileStorage.from_dense(
+            jnp.conj(Vbh.astype(V2.dtype)).T, nb, nb, grid))
+        Vn = gemm(1.0, V2m, Vbm, opts=opts)
     # U = U1 [Un; 0], V = V1 Vn, both distributed panel chains.  Pad Un
     # [n, n] to [m, n] in TILE space — a static cyclic-slot scatter, never
     # a replicated [m, n] dense intermediate (m can be huge for tall A)

@@ -68,16 +68,61 @@ def _lu_nopiv_square(a):
     return lax.fori_loop(0, n, body, a)
 
 
-def panel_lu_tournament(panel, block_rows: int):
+def panel_lu_threshold(panel, tau):
+    """Threshold-pivoted LU of a panel [W, nb] (ref: Option::PivotThreshold,
+    enums.hh:91 'threshold for pivoting, >= 0, <= 1'; used by the reference
+    getrf panel to prefer the diagonal when it is within ``tau`` of the
+    column max, trading a bounded growth factor for fewer row swaps).
+
+    One fori_loop of masked rank-1 steps; returns (lu, perm) like
+    :func:`panel_lu`.
+    """
+    W, nb = panel.shape
+    rows = jnp.arange(W)
+    tau = jnp.asarray(tau, jnp.real(panel).dtype)
+
+    def body(j, carry):
+        a, perm = carry
+        col = lax.dynamic_index_in_dim(a, j, axis=1, keepdims=False)
+        mag = jnp.where(rows >= j, jnp.abs(col), -jnp.ones_like(
+            jnp.abs(col)))
+        cmax = jnp.max(mag)
+        diag = jnp.abs(col[j])
+        pos = jnp.where(diag >= tau * cmax, j, jnp.argmax(mag))
+        # swap rows j <-> pos
+        rj, rp = a[j], a[pos]
+        a = a.at[j].set(rp).at[pos].set(rj)
+        pj, pp = perm[j], perm[pos]
+        perm = perm.at[j].set(pp).at[pos].set(pj)
+        # eliminate below the diagonal
+        colj = lax.dynamic_index_in_dim(a, j, axis=1, keepdims=False)
+        piv = colj[j]
+        safe = jnp.where(piv == 0, jnp.ones_like(piv), piv)
+        l = jnp.where((rows > j) & (piv != 0), colj / safe,
+                      jnp.zeros_like(colj))
+        cols = jnp.arange(nb)
+        rowj = jnp.where(cols > j, a[j], jnp.zeros_like(a[j]))
+        a = a - jnp.outer(l, rowj)
+        a = a.at[:, j].set(jnp.where(rows > j, l, colj))
+        return a, perm
+
+    lu, perm = lax.fori_loop(0, min(W, nb), body,
+                             (panel, jnp.arange(W)))
+    return lu, perm
+
+
+def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     """CALU tournament pivot selection + clean factorization
     (ref: internal_getrf_tntpiv.cc, Tile_getrf_tntpiv.hh).
 
     Round 1: factor each block of ``block_rows`` rows independently and keep
-    its nb pivot rows.  Reduction rounds: pairwise merge candidate sets with
-    another LU until one set remains.  Finally permute the chosen rows to the
-    top and factor the whole panel without further pivoting across blocks.
+    its nb pivot rows.  Reduction rounds: merge ``arity`` candidate sets at
+    a time (Option.Depth — the reduction-tree fan-in) with another LU until
+    one set remains.  Finally permute the chosen rows to the top and factor
+    the whole panel without further pivoting across blocks.
     Returns (lu, perm) like :func:`panel_lu`.
     """
+    arity = max(2, int(arity))
     W, nb = panel.shape
     rows = jnp.arange(W)
 
@@ -98,16 +143,18 @@ def panel_lu_tournament(panel, block_rows: int):
             b, i = best_rows(blk, rows[s:e])
             cands.append(b)
             cidx.append(i)
-    # reduction tree
+    # reduction tree, fan-in = arity
     while len(cands) > 1:
         nxt_c, nxt_i = [], []
-        for t in range(0, len(cands), 2):
-            if t + 1 == len(cands):
-                nxt_c.append(cands[t])
-                nxt_i.append(cidx[t])
+        for t in range(0, len(cands), arity):
+            grp_c = cands[t: t + arity]
+            grp_i = cidx[t: t + arity]
+            if len(grp_c) == 1:
+                nxt_c.append(grp_c[0])
+                nxt_i.append(grp_i[0])
             else:
-                merged = jnp.concatenate([cands[t], cands[t + 1]], axis=0)
-                midx = jnp.concatenate([cidx[t], cidx[t + 1]])
+                merged = jnp.concatenate(grp_c, axis=0)
+                midx = jnp.concatenate(grp_i)
                 b, i = best_rows(merged, midx)
                 nxt_c.append(b)
                 nxt_i.append(i)

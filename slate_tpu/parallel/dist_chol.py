@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.herk import herk_panel_update
+from ..util.trace import span
 from ..internal.potrf import potrf_tile
 from ..internal.trsm import trsm_tile_batch
 from ..types import Op
@@ -72,44 +73,46 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
         vmask = (idx[:, None] < vk) & (idx[None, :] < vk)
 
         # -- diagonal tile: gather from owner, factor everywhere --
-        dtile = lax.dynamic_index_in_dim(
-            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
-            kkc, axis=0, keepdims=False)
-        dtile = jnp.where((r == rk) & (c == ck), dtile,
-                          jnp.zeros((nb, nb), dt))
-        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
-        # Hermitian-complete from the stored lower triangle: only the lower
-        # triangle of the input is ever read, so callers may pass storage
-        # whose upper tiles hold junk (XLA's cholesky reads the full tile
-        # on some backends)
-        dlow = jnp.tril(dtile)
-        ddiag = jnp.diagonal(dtile)
-        if jnp.iscomplexobj(dtile):
-            ddiag = jnp.real(ddiag).astype(dt)
-        dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
-        lkk_aug = potrf_tile(dtile + pad_eye)
-        lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
+        with span("slate.potrf/panel"):
+            dtile = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+                kkc, axis=0, keepdims=False)
+            dtile = jnp.where((r == rk) & (c == ck), dtile,
+                              jnp.zeros((nb, nb), dt))
+            dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+            # Hermitian-complete from the stored lower triangle: only the
+            # lower triangle of the input is ever read, so callers may pass
+            # storage whose upper tiles hold junk (XLA's cholesky reads the
+            # full tile on some backends)
+            dlow = jnp.tril(dtile)
+            ddiag = jnp.diagonal(dtile)
+            if jnp.iscomplexobj(dtile):
+                ddiag = jnp.real(ddiag).astype(dt)
+            dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
+            lkk_aug = potrf_tile(dtile + pad_eye)
+            lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
 
-        # -- panel trsm on the owner column's local tiles --
-        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
-        sol = trsm_tile_batch(lkk_aug, pan, left=False, lower=True,
-                              op_tri=Op.ConjTrans)
+            # -- panel trsm on the owner column's local tiles --
+            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+            sol = trsm_tile_batch(lkk_aug, pan, left=False, lower=True,
+                                  op_tri=Op.ConjTrans)
 
-        keep = (gi_all[:, None, None] <= k)
-        newcol = jnp.where(keep, pan, sol)
-        newcol = jnp.where((gi_all == k)[:, None, None], lkk, newcol)
-        col_sel = jnp.where(c == ck, newcol, pan)
-        a_loc = lax.dynamic_update_slice(
-            a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+            keep = (gi_all[:, None, None] <= k)
+            newcol = jnp.where(keep, pan, sol)
+            newcol = jnp.where((gi_all == k)[:, None, None], lkk, newcol)
+            col_sel = jnp.where(c == ck, newcol, pan)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
 
         # -- broadcast the panel column to every rank (ref listBcastMT
         #    potrf.cc:232-242): scatter to global buffer, psum the mesh --
-        buf = jnp.zeros((p * mtl, nb, nb), dt)
-        contrib = jnp.where((gi_all > k)[:, None, None], sol,
-                            jnp.zeros_like(sol))
-        buf = buf.at[gi_all].set(contrib)
-        buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
-        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)   # [p*mtl, nb, nb]
+        with span("slate.potrf/bcast"):
+            buf = jnp.zeros((p * mtl, nb, nb), dt)
+            contrib = jnp.where((gi_all > k)[:, None, None], sol,
+                                jnp.zeros_like(sol))
+            buf = buf.at[gi_all].set(contrib)
+            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+            gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)  # [p*mtl, nb, nb]
         return a_loc, gpan
 
     for k0 in range(0, Nt, sb):
@@ -129,7 +132,8 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                 gj = c + q * (sc + jnp.arange(T))
                 prow = gpan[gi]                   # [S, nb, nb]
                 pcol = gpan[gj]                   # [T, nb, nb]
-                upd = herk_panel_update(prow, pcol)
+                with span("slate.potrf/herk"):
+                    upd = herk_panel_update(prow, pcol)
                 cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
                                         (S, T, nb, nb))
                 mask = ((gi > k)[:, None, None, None] &

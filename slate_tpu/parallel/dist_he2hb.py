@@ -45,6 +45,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.qr import build_t, householder_panel, unit_lower
 from .dist_chol import superblock
+from ..util.trace import span
 from .dist_lu import _gather_panel
 
 
@@ -87,7 +88,8 @@ def _he2hb_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
             kkc = k // q
 
             # ---- gather + factor the panel (replicated) ----
-            gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+            with span("slate.he2hb/panel"):
+                gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
             panel = gpan[k0 + 1: Nt].reshape(W0 * nb, nb)
             shift = (k - k0) * nb
             panel = jnp.roll(panel, -shift, axis=0)
@@ -141,7 +143,8 @@ def _he2hb_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                               jnp.where(eq, _tril_real_diag(A_win), zer))
             Aeff2 = jnp.where(low, A_win,
                               jnp.where(eq, jnp.tril(A_win, -1), zer))
-            y1 = jnp.einsum('stab,tbc->sac', Aeff1, Vc)
+            with span("slate.he2hb/hemm"):
+                y1 = jnp.einsum('stab,tbc->sac', Aeff1, Vc)
             y2 = jnp.einsum('stab,sac->tbc', jnp.conj(Aeff2), Vr)
             ybuf = jnp.zeros((p * mtl, nb, nb), dt)
             ybuf = ybuf.at[gi].add(y1)
@@ -157,8 +160,9 @@ def _he2hb_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
 
             # ---- her2k trailing update, fully local ----
             Wr, Wc = Wt[gi], Wt[gj]
-            upd = (jnp.einsum('sac,tbc->stab', Vr, jnp.conj(Wc))
-                   + jnp.einsum('sac,tbc->stab', Wr, jnp.conj(Vc)))
+            with span("slate.he2hb/her2k"):
+                upd = (jnp.einsum('sac,tbc->stab', Vr, jnp.conj(Wc))
+                       + jnp.einsum('sac,tbc->stab', Wr, jnp.conj(Vc)))
             geq = (gi[:, None] >= gj[None, :])[:, :, None, None]
             new = jnp.where(geq, A_win - upd, A_win)
             a_loc = lax.dynamic_update_slice(a_loc, new, (sr, sc, zi, zi))

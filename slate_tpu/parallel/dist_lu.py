@@ -41,7 +41,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
-from ..internal.getrf import panel_lu, panel_lu_nopiv, panel_lu_tournament
+from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
+                              panel_lu_tournament)
 from .dist_chol import superblock
 
 
@@ -92,7 +93,8 @@ def _row_bundle_exchange(a_loc, out_rows, in_rows, p, r, nbundle):
 
 
 def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
-                      ib: int, sb: int):
+                      ib: int, sb: int, tau: float = 1.0, mpt: int = 4,
+                      depth: int = 2):
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
@@ -136,8 +138,10 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             if method == "nopiv":
                 lu, perm = panel_lu_nopiv(panel)
             elif method == "tntpiv":
-                lu, perm = panel_lu_tournament(panel,
-                                               block_rows=max(ib, nb))
+                lu, perm = panel_lu_tournament(
+                    panel, block_rows=max(ib, mpt * nb), arity=depth)
+            elif tau < 1.0:
+                lu, perm = panel_lu_threshold(panel, tau)
             else:
                 lu, perm = panel_lu(panel)
             lut = lu.reshape(W0, nb, nb)
@@ -265,16 +269,21 @@ def dist_permute_rows(b_data, perm, grid: Grid):
 
 
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
-               ib: int = 16, sb: int | None = None):
+               ib: int = 16, sb: int | None = None, tau: float = 1.0,
+               mpt: int = 4, depth: int = 2):
     """Factor square cyclic storage in place; returns (data, perm) with
-    A[perm] = L @ U (perm over the padded row space, identity on pads)."""
+    A[perm] = L @ U (perm over the padded row space, identity on pads).
+
+    ``tau`` (Option.PivotThreshold) < 1 switches the partial-pivot panel to
+    threshold pivoting; ``mpt`` (Option.MaxPanelThreads) sizes the CALU
+    tournament row blocks; ``depth`` (Option.Depth) its tree fan-in."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = jax.shard_map(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
-                                    method, ib, sb),
+                                    method, ib, sb, tau, mpt, depth),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P()))
     return fn(data)

@@ -1,0 +1,88 @@
+"""Failure-path contracts (VERDICT r3 item 10): singular gbtrf, non-HPD
+pbtrf/potrf eager vs traced, and non-converged mixed without fallback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.exceptions import SlateNotPositiveDefiniteError
+from slate_tpu.options import Option
+
+
+def test_gbtrf_singular_produces_nonfinite(rng):
+    # exactly singular band matrix: the unpivoted-across-blocks window LU
+    # hits a zero pivot; the documented contract is LAPACK-style garbage-in
+    # signalling — non-finite values in the factors/solve, never a wrong
+    # finite answer
+    n, kl, ku, mb = 12, 2, 2, 4
+    a = np.triu(np.tril(rng.standard_normal((n, n)), kl), -ku)
+    a[:, 3] = 0.0
+    a[3, :] = 0.0                       # row+col zero => singular
+    A = st.BandMatrix.from_numpy(a, kl, ku, mb)
+    B = st.Matrix.from_numpy(rng.standard_normal((n, 1)), mb, mb)
+    F = st.gbtrf(A)
+    X = st.gbtrs(F, B)
+    assert not np.all(np.isfinite(X.to_numpy()))
+
+
+def test_pbtrf_not_hpd_eager_raises(rng):
+    n, kd, mb = 10, 2, 5
+    a = rng.standard_normal((n, n))
+    band = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n)))
+                    <= kd, (a + a.T) / 2, 0.0)
+    band -= 10 * np.eye(n)              # negative definite
+    HB = st.HermitianBandMatrix.from_numpy(band, kd, mb)
+    with pytest.raises(SlateNotPositiveDefiniteError):
+        st.pbtrf(HB)
+
+
+def test_pbtrf_not_hpd_traced_nan(rng):
+    # under jit the check cannot raise: the documented contract is the XLA
+    # convention — NaNs in the factor
+    n, kd, mb = 10, 2, 5
+    a = rng.standard_normal((n, n))
+    band = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n)))
+                    <= kd, (a + a.T) / 2, 0.0)
+    band -= 10 * np.eye(n)
+    HB = st.HermitianBandMatrix.from_numpy(band, kd, mb)
+
+    @jax.jit
+    def factor(H):
+        return st.pbtrf(H).L_band
+
+    lband = factor(HB)
+    assert not bool(jnp.all(jnp.isfinite(lband)))
+
+
+def test_potrf_not_spd_traced_nan(rng):
+    n, nb = 12, 4
+    a = rng.standard_normal((n, n))
+    nd = -((a @ a.T) + n * np.eye(n))   # negative definite
+    A = st.HermitianMatrix.from_numpy(nd, nb)
+
+    @jax.jit
+    def factor(H):
+        return st.potrf(H).to_dense()
+
+    out = factor(A)
+    assert not bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_mixed_no_fallback_reports_nonconvergence(rng):
+    # ill-conditioned system: f32-factor IR cannot reach f64 accuracy; with
+    # the fallback disabled the documented contract is converged=False with
+    # the low-precision-IR iterate returned as-is
+    n, nb = 24, 8
+    u = np.linalg.qr(rng.standard_normal((n, n)))[0]
+    s = np.logspace(0, 14, n)           # cond 1e14
+    a = (u * s) @ u.T
+    a = (a + a.T) / 2
+    b = rng.standard_normal((n, 1))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    res = st.posv_mixed(A, B, {Option.UseFallbackSolver: False,
+                               Option.MaxIterations: 3})
+    assert not bool(res.converged)
+    assert int(res.iters) >= 3

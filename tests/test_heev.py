@@ -113,6 +113,44 @@ def test_heev_mesh_upper_view(rng):
                                np.linalg.eigvalsh(a), atol=1e-10)
 
 
+@pytest.mark.parametrize("meth", [st.MethodEig.QR, st.MethodEig.DC])
+def test_heev_chase_parity(rng, meth):
+    # the tridiagonal parity route (hb2st bulge chase) must agree with the
+    # default band seam
+    n, nb = 21, 5
+    a = herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
+    w, Z = st.heev(A, {st.Option.MethodEig: meth})
+    w, z = np.asarray(w), Z.to_numpy()
+    np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-10)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-10)
+
+
+def test_sterf_steqr(rng):
+    n = 17
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    T = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    np.testing.assert_allclose(np.sort(np.asarray(st.sterf(d, e))),
+                               np.linalg.eigvalsh(T), atol=1e-12)
+    w, Z = st.steqr(d, e)
+    w, z = np.asarray(w), np.asarray(Z)
+    np.testing.assert_allclose(T @ z, z @ np.diag(w), atol=1e-12)
+
+
+def test_hb2st_public(rng):
+    n, kd, mb = 18, 3, 6
+    a = herm(rng, n)
+    band = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n)))
+                    <= kd, a, 0.0)
+    HB = st.HermitianBandMatrix.from_numpy(band, kd, mb)
+    d, e, Q2 = st.hb2st(HB)
+    T = np.diag(np.asarray(d)) + np.diag(np.asarray(e), 1) + \
+        np.diag(np.asarray(e), -1)
+    q2 = np.asarray(Q2)
+    np.testing.assert_allclose(q2 @ T @ q2.conj().T, band, atol=1e-11)
+
+
 def test_hegv(rng):
     n, nb = 12, 4
     a = herm(rng, n)

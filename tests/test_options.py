@@ -1,0 +1,126 @@
+"""Every Option enum member has a real consumer (VERDICT r3 item 8):
+these tests drive the newly wired ones end-to-end."""
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import compat
+from slate_tpu.options import Option
+
+
+def _spd(rng, n):
+    a = rng.standard_normal((n, n))
+    return a @ a.T + n * np.eye(n)
+
+
+def test_pivot_threshold_solve(rng):
+    # threshold pivoting solves accurately on a matrix that needs pivoting
+    n, nb = 24, 8
+    a = rng.standard_normal((n, n))
+    a[0, 0] = 1e-12                      # force an off-diagonal pivot
+    b = rng.standard_normal((n, 2))
+    F, X = st.gesv(st.Matrix.from_numpy(a, nb, nb),
+                   st.Matrix.from_numpy(b, nb, nb),
+                   {Option.PivotThreshold: 0.5})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+    # the permutation really moved row 0's pivot
+    assert int(np.asarray(F.perm)[0]) != 0
+
+
+def test_pivot_threshold_prefers_diagonal(rng):
+    # tau=0: always accept the diagonal => no row swaps on any nonsingular
+    # matrix (the threshold semantics, ref enums.hh PivotThreshold)
+    n, nb = 16, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    F = st.getrf(st.Matrix.from_numpy(a, nb, nb),
+                 {Option.PivotThreshold: 1e-12})
+    np.testing.assert_array_equal(np.asarray(F.perm), np.arange(n))
+
+
+def test_tournament_mpt_depth(rng):
+    n, nb = 40, 4
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 1))
+    F, X = st.gesv(st.Matrix.from_numpy(a, nb, nb),
+                   st.Matrix.from_numpy(b, nb, nb),
+                   {Option.MethodLU: st.MethodLU.CALU,
+                    Option.MaxPanelThreads: 2, Option.Depth: 3})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_tolerance_consumed(rng):
+    n, nb = 32, 8
+    a = _spd(rng, n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    res = st.posv_mixed(A, B, {Option.Tolerance: 1e-6})
+    np.testing.assert_allclose(a @ res.X.to_numpy(), b, rtol=0, atol=1e-4)
+
+
+def test_hold_local_workspace_fused_posv(rng):
+    n, nb = 24, 8
+    a = _spd(rng, n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    L, X = st.posv(A, B, {Option.HoldLocalWorkspace: True})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_lookahead_mesh_posv(rng):
+    n, nb = 32, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = _spd(rng, n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(a, nb, grid=g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    L, X = st.posv(A, B, {Option.Lookahead: 2})
+    np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-9)
+
+
+def test_blocksize_compat(rng):
+    n = 20
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    x, perm = compat.lapack.gesv(a, b, opts={Option.BlockSize: 16})
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+
+
+def test_hemm_right_hemmA_honored(rng):
+    # an explicit stationary-A request on the Right side routes through the
+    # Hermitian transpose identity instead of being silently dropped
+    n, k, nb = 16, 12, 4
+    a = rng.standard_normal((n, n))
+    h = (a + a.T) / 2
+    b = rng.standard_normal((k, n))
+    H = st.HermitianMatrix.from_numpy(h, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    C = st.hemm("r", 2.0, H, B, opts={Option.MethodHemm: st.MethodHemm.hemmA})
+    np.testing.assert_allclose(C.to_numpy(), 2.0 * b @ h, atol=1e-10)
+
+
+def test_every_option_member_consumed():
+    """Static check: each Option member is consumed outside options.py —
+    either read directly (Option.X) or through its dedicated accessor
+    (resolve_target / select_*_method), which itself reads the option."""
+    import pathlib
+    root = pathlib.Path(st.__file__).parent
+    src = ""
+    for f in root.rglob("*.py"):
+        if f.name != "options.py":
+            src += f.read_text()
+    accessor = {
+        "Target": "resolve_target(",
+        "MethodGemm": "select_gemm_method(",
+        "MethodTrsm": "select_trsm_method(",
+        "MethodGels": "select_gels_method(",
+        "MethodLU": "select_lu_method(",
+    }
+    missing = [m.name for m in Option
+               if f"Option.{m.name}" not in src
+               and accessor.get(m.name, "\x00") not in src]
+    assert not missing, f"inert options: {missing}"

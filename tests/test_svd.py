@@ -96,3 +96,36 @@ def test_svd_mesh_complex(rng):
     s = np.asarray(s)
     u, v = U.to_numpy(), V.to_numpy()
     np.testing.assert_allclose(u * s[None, :] @ v.conj().T, a, atol=1e-9)
+
+
+def test_svd_chase_parity(rng):
+    # the bidiagonal parity route (tb2bd bulge chase) must agree with the
+    # default band seam
+    m, n, nb = 19, 13, 4
+    a = _mat(rng, m, n)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    s, U, V = st.svd(A, {st.Option.MethodSvd: st.MethodSvd.Bidiag})
+    s = np.asarray(s)
+    u, v = U.to_numpy(), V.to_numpy()
+    np.testing.assert_allclose(u * s[None, :] @ v.conj().T, a, atol=1e-10)
+    np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                               atol=1e-10)
+
+
+def test_bdsqr_tb2bd_public(rng):
+    n = 12
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    B = np.diag(d) + np.diag(e, 1)
+    s, U, Vh = st.bdsqr(d, e)
+    np.testing.assert_allclose(np.asarray(s),
+                               np.linalg.svd(B, compute_uv=False), atol=1e-12)
+    kd, mb = 3, 4
+    bu = np.triu(np.tril(rng.standard_normal((n, n)), kd), 0)
+    bu = np.triu(bu)  # upper band, bandwidth kd
+    bu = np.where(np.subtract.outer(np.arange(n), np.arange(n)) >= -kd, bu, 0)
+    TB = st.TriangularBandMatrix.from_numpy(bu, kd, mb, st.Uplo.Upper)
+    d2, e2, U2, V2 = st.tb2bd(TB)
+    B2 = np.diag(np.asarray(d2)) + np.diag(np.asarray(e2), 1)
+    u2, v2 = np.asarray(U2), np.asarray(V2)
+    np.testing.assert_allclose(u2 @ B2 @ v2.conj().T, bu, atol=1e-11)
