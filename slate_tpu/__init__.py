@@ -52,6 +52,7 @@ from .drivers.band import (  # noqa: F401
 from .drivers.heev import (  # noqa: F401
     heev, heev_vals, heevd, hegst, hegv, hb2st, steqr, sterf,
 )
+from .drivers.stedc import stedc  # noqa: F401
 from .drivers.printing import format_matrix, print_matrix  # noqa: F401
 from .drivers.condest import gecondest, norm1est, trcondest  # noqa: F401
 from .drivers.hetrf import HEFactors, hesv, hetrf, hetrs  # noqa: F401

@@ -16,9 +16,10 @@ TPU-first shape:
   static kd-sized windows — the sequential dependency chain the reference
   schedules with its sweep/step progress table (hb2st.cc:139-186) becomes a
   single compiled scan; per-step work is O(kd^2) on dynamic slices.
-- tridiagonal kernel: XLA's eigh on the assembled tridiagonal — the vendor
-  kernel seam, as the reference calls LAPACK steqr2/stedc there.  (stedc
-  divide & conquer is the planned upgrade on this seam.)
+- stage-2 seam (MethodEig): Auto eigendecomposes the band directly with
+  the vendor eigh (no chase — see _stage2_eig); DC chases to tridiagonal
+  and runs the native divide & conquer (drivers/stedc.py); QR chases and
+  uses the vendor eigh of T (the steqr2 analog).
 - eigenvectors: Z = Q1 (Q2 Z_tri): Q2 accumulated inside the chase scan,
   Q1 applied panel-wise with larfb gemms (unmtr_he2hb).
 """
@@ -209,9 +210,16 @@ def _hb2st(band, kd: int, want_q: bool):
 
 # ---------------------------------------------------------------- driver
 
-def _tridiag_eig(d, e, want_z: bool):
-    """Vendor-kernel seam (ref: heev.cc:141-153 steqr2/stedc dispatch): the
-    tridiagonal problem solved by XLA's native eigh (QDWH on TPU)."""
+def _tridiag_eig(d, e, want_z: bool, opts: Options | None = None):
+    """Tridiagonal kernel seam (ref: heev.cc:141-153 steqr2/stedc
+    dispatch): MethodEig.DC runs the native divide & conquer
+    (drivers/stedc.py — merge work is MXU gemms, the reference's default);
+    MethodEig.QR is the vendor seam (XLA eigh of the assembled T, the
+    steqr2 analog)."""
+    meth = get_option(opts, Option.MethodEig)
+    if meth is MethodEig.DC and want_z and d.shape[0] > 1:
+        from .stedc import stedc
+        return stedc(d, e)
     n = d.shape[0]
     T = (jnp.diag(d) + jnp.diag(e, -1) + jnp.diag(e, 1)
          if n > 1 else jnp.diag(d))
@@ -240,7 +248,7 @@ def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None):
             return w, Z2
         return jnp.linalg.eigvalsh(band), None
     d, e, Q2 = _hb2st(band, nb, want_q=jobz)
-    w, ztri = _tridiag_eig(d, e, jobz)
+    w, ztri = _tridiag_eig(d, e, jobz, opts)
     if not jobz:
         return w, None
     return w, Q2 @ ztri.astype(Q2.dtype)
@@ -310,7 +318,6 @@ def _heev_mesh(A, opts, jobz: bool):
     (ref: heev.cc:104-111).  The Q2 Z_tri product and the Q1
     back-transform are mesh-distributed (SUMMA gemm + dist_unmtr_he2hb)."""
     from ..parallel.dist_he2hb import dist_he2hb, dist_unmtr_he2hb
-    from .blas3 import gemm
     n, nb = A.m, A.nb
     grid = A.grid
     # zero-copy for canonical lower storage; ConjTrans is also safe (the
@@ -331,22 +338,13 @@ def _heev_mesh(A, opts, jobz: bool):
                                         SUPERBLOCKS * la))
     st_packed = TileStorage(data, st_in.m, st_in.n, nb, nb, grid)
     band = _band_from_tiles(st_packed, n, nb)
-    meth = get_option(opts, Option.MethodEig)
-    if meth is MethodEig.Auto:
-        w, Z2 = _stage2_eig(band, nb, jobz, opts)
-        if not jobz:
-            return w, None
-        Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))
-    else:
-        d, e, Q2 = _hb2st(band, nb, want_q=jobz)
-        w, ztri = _tridiag_eig(d, e, jobz)
-        if not jobz:
-            return w, None
-        # Z = Q2 Z_tri as a mesh SUMMA gemm
-        Q2m = Matrix(TileStorage.from_dense(Q2, nb, nb, grid))
-        Ztm = Matrix(TileStorage.from_dense(ztri.astype(Q2.dtype), nb, nb,
-                                            grid))
-        Z0 = gemm(1.0, Q2m, Ztm, opts=opts)
+    # ONE stage-2 dispatch shared with the single-target path (stage 2 is
+    # single-node by design, as the reference's is); only the stage-1
+    # back-transform below is mesh-distributed
+    w, Z2 = _stage2_eig(band, nb, jobz, opts)
+    if not jobz:
+        return w, None
+    Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))
     z_data = dist_unmtr_he2hb(data, Ts, Z0.storage.data, st_in.Nt, grid, n=n)
     zs = Z0.storage
     return w, Matrix(TileStorage(z_data, zs.m, zs.n, zs.mb, zs.nb, zs.grid))

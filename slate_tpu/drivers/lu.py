@@ -53,8 +53,9 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
     reference's lapack panel kernel); trailing update is trsm + one MXU
     gemm per panel (ref: getrf.cc:174-215 trailing task).  ``tau`` < 1
     switches to threshold pivoting (Option.PivotThreshold); ``mpt``
-    (Option.MaxPanelThreads) sizes the tournament's independent row blocks
-    (the analog of panel threads) and ``depth`` (Option.Depth) its
+    (Option.MaxPanelThreads) splits the tournament panel into ~mpt
+    independent row blocks (the analog of panel threads: more threads =
+    more, smaller blocks) and ``depth`` (Option.Depth) is the
     reduction-tree fan-in."""
     from ..internal.getrf import (panel_lu, panel_lu_nopiv,
                                   panel_lu_threshold, panel_lu_tournament)
@@ -68,8 +69,9 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
         if method == "nopiv":
             lu, perm = panel_lu_nopiv(pan)
         elif method == "tntpiv":
-            lu, perm = panel_lu_tournament(pan, block_rows=mpt * nb,
-                                           arity=depth)
+            bh = pan.shape[0]
+            br = max(nb, (-(-bh // (mpt * nb))) * nb)
+            lu, perm = panel_lu_tournament(pan, block_rows=br, arity=depth)
         elif tau < 1.0:
             lu, perm = panel_lu_threshold(pan, tau)
         else:

@@ -335,7 +335,6 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
     back-transforms are distributed panel applies."""
     from ..parallel.dist_ge2tb import (dist_ge2tb, dist_unmbr_ge2tb_u,
                                        dist_unmbr_ge2tb_v)
-    from .blas3 import gemm
     m, n, nb = A.m, A.n, A.nb
     grid = A.grid
     if (A.op is Op.NoTrans and A.is_root_view() and A.storage.mb == nb):
@@ -349,27 +348,15 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
                                               SUPERBLOCKS * la))
     st_packed = TileStorage(data, m, n, nb, nb, grid)
     band = _band_upper_from_tiles(st_packed, n, nb)
-    meth = get_option(opts, Option.MethodSvd)
-    if meth is MethodSvd.Auto:
-        s, Uns, Vns = _stage2_svd(band, nb, jobu, opts)
-        if not jobu:
-            return s, None, None
-        dt = st_packed.dtype
-        Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
-        Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
-    else:
-        d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
-        s, Ub, Vbh = _bd_svd(d, e, jobu)
-        if not jobu:
-            return s, None, None
-        U2m = Matrix(TileStorage.from_dense(U2, nb, nb, grid))
-        Ubm = Matrix(TileStorage.from_dense(Ub.astype(U2.dtype), nb, nb,
-                                            grid))
-        Un = gemm(1.0, U2m, Ubm, opts=opts)      # [n, n] mesh product
-        V2m = Matrix(TileStorage.from_dense(V2, nb, nb, grid))
-        Vbm = Matrix(TileStorage.from_dense(
-            jnp.conj(Vbh.astype(V2.dtype)).T, nb, nb, grid))
-        Vn = gemm(1.0, V2m, Vbm, opts=opts)
+    # ONE stage-2 dispatch shared with the single-target path (stage 2 is
+    # single-node by design, as the reference's is); only the stage-1
+    # back-transforms below are mesh-distributed
+    s, Uns, Vns = _stage2_svd(band, nb, jobu, opts)
+    if not jobu:
+        return s, None, None
+    dt = st_packed.dtype
+    Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
+    Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
     # U = U1 [Un; 0], V = V1 Vn, both distributed panel chains.  Pad Un
     # [n, n] to [m, n] in TILE space — a static cyclic-slot scatter, never
     # a replicated [m, n] dense intermediate (m can be huge for tall A)

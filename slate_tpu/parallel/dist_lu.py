@@ -138,8 +138,9 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             if method == "nopiv":
                 lu, perm = panel_lu_nopiv(panel)
             elif method == "tntpiv":
-                lu, perm = panel_lu_tournament(
-                    panel, block_rows=max(ib, mpt * nb), arity=depth)
+                br = max(ib, nb, (-(-panel.shape[0] // (mpt * nb))) * nb)
+                lu, perm = panel_lu_tournament(panel, block_rows=br,
+                                               arity=depth)
             elif tau < 1.0:
                 lu, perm = panel_lu_threshold(panel, tau)
             else:
