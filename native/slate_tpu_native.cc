@@ -1,0 +1,119 @@
+// slate_tpu native runtime: host-side storage-layer kernels + C API.
+//
+// Analog of the reference's native storage/layout layer
+// (ref: include/slate/internal/MatrixStorage.hh tile map + distribution
+// lambdas; include/slate/Tile.hh:707 layoutConvert; src/c_api/wrappers.cc
+// C API tier).  The TPU compute path is JAX/XLA; what remains native is
+// the HOST runtime around it: importing/exporting user LAPACK/ScaLAPACK
+// buffers into the framework's 2D block-cyclic tile layout
+// [p*mtl, q*ntl, mb, nb] at memory bandwidth (OpenMP across tiles), plus
+// the ScaLAPACK descriptor arithmetic.  Python binds via ctypes
+// (slate_tpu/native.py) with a pure-numpy fallback when the library is
+// not built.
+//
+// Build: make -C native   (g++ -O3 -march=native -fopenmp -shared -fPIC)
+//
+// Layout contract (must match slate_tpu/core/layout.py):
+//   cyclic slot (s, t) holds tile (i, j) with
+//     i = (s % mtl) * p + s / mtl,   j = (t % ntl) * q + t / ntl
+//   i.e. storage row s = (i % p) * mtl + i / p, mtl = ceil(Mt / p).
+//   Tiles are row-major [mb, nb]; out-of-range elements are ZERO (the
+//   pad-is-zero invariant every kernel relies on).
+
+#include <cstdint>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Library identity (analog of src/version.cc / c_api slate_version).
+int64_t slate_tpu_native_version(void) { return 20260730; }
+
+// ScaLAPACK numroc: rows of an n x nb-blocked dimension owned by iproc
+// (ref: scalapack tools/numroc.f; used by compat/scalapack.py).
+int64_t slate_tpu_numroc(int64_t n, int64_t nb, int64_t iproc,
+                         int64_t isrcproc, int64_t nprocs) {
+    int64_t mydist = (nprocs + iproc - isrcproc) % nprocs;
+    int64_t nblocks = n / nb;
+    int64_t numroc = (nblocks / nprocs) * nb;
+    int64_t extrablks = nblocks % nprocs;
+    if (mydist < extrablks)
+        numroc += nb;
+    else if (mydist == extrablks)
+        numroc += n % nb;
+    return numroc;
+}
+
+// Pack a ROW-major m x n matrix with row stride ld into the framework's
+// cyclic tile array dst[p*mtl][q*ntl][mb][nb] (row-major throughout).
+// Row-major matches numpy's default order so the Python binding passes
+// buffers straight through with no transpose copy; LAPACK column-major
+// callers pass the transpose view and flip (m, n).  Pads with zeros.
+#define DEFINE_PACK(NAME, T)                                               \
+void NAME(const T* src, int64_t m, int64_t n, int64_t ld, int64_t mb,      \
+          int64_t nb, int64_t p, int64_t q, T* dst) {                      \
+    int64_t Mt = (m + mb - 1) / mb, Nt = (n + nb - 1) / nb;                \
+    int64_t mtl = (Mt + p - 1) / p, ntl = (Nt + q - 1) / q;                \
+    int64_t rows = p * mtl, cols = q * ntl;                                \
+    _Pragma("omp parallel for collapse(2) schedule(static)")               \
+    for (int64_t s = 0; s < rows; ++s) {                                   \
+        for (int64_t t = 0; t < cols; ++t) {                               \
+            int64_t i = (s % mtl) * p + s / mtl;                           \
+            int64_t j = (t % ntl) * q + t / ntl;                           \
+            T* tile = dst + ((s * cols + t) * mb) * nb;                    \
+            if (i >= Mt || j >= Nt) {                                      \
+                std::memset(tile, 0, sizeof(T) * mb * nb);                 \
+                continue;                                                  \
+            }                                                              \
+            int64_t r0 = i * mb, c0 = j * nb;                              \
+            int64_t rlim = (r0 + mb <= m) ? mb : (m > r0 ? m - r0 : 0);    \
+            int64_t clim = (c0 + nb <= n) ? nb : (n > c0 ? n - c0 : 0);    \
+            for (int64_t a = 0; a < rlim; ++a) {                           \
+                const T* srow = src + (r0 + a) * ld + c0;                  \
+                T* trow = tile + a * nb;                                   \
+                for (int64_t b = 0; b < clim; ++b)                         \
+                    trow[b] = srow[b];                                     \
+                for (int64_t b = clim; b < nb; ++b) trow[b] = (T)0;        \
+            }                                                              \
+            for (int64_t a = rlim; a < mb; ++a)                            \
+                std::memset(tile + a * nb, 0, sizeof(T) * nb);             \
+        }                                                                  \
+    }                                                                      \
+}
+
+DEFINE_PACK(slate_tpu_pack_tiles_f64, double)
+DEFINE_PACK(slate_tpu_pack_tiles_f32, float)
+
+// Unpack the cyclic tile array back into a ROW-major m x n buffer
+// (row stride ld).
+#define DEFINE_UNPACK(NAME, T)                                             \
+void NAME(const T* src, int64_t m, int64_t n, int64_t ld, int64_t mb,      \
+          int64_t nb, int64_t p, int64_t q, T* dst) {                      \
+    int64_t Mt = (m + mb - 1) / mb, Nt = (n + nb - 1) / nb;                \
+    int64_t mtl = (Mt + p - 1) / p, ntl = (Nt + q - 1) / q;                \
+    int64_t cols = q * ntl;                                                \
+    _Pragma("omp parallel for collapse(2) schedule(static)")               \
+    for (int64_t i = 0; i < Mt; ++i) {                                     \
+        for (int64_t j = 0; j < Nt; ++j) {                                 \
+            int64_t s = (i % p) * mtl + i / p;                             \
+            int64_t t = (j % q) * ntl + j / q;                             \
+            const T* tile = src + ((s * cols + t) * mb) * nb;              \
+            int64_t r0 = i * mb, c0 = j * nb;                              \
+            int64_t rlim = (r0 + mb <= m) ? mb : m - r0;                   \
+            int64_t clim = (c0 + nb <= n) ? nb : n - c0;                   \
+            for (int64_t a = 0; a < rlim; ++a) {                           \
+                T* drow = dst + (r0 + a) * ld + c0;                        \
+                for (int64_t b = 0; b < clim; ++b)                         \
+                    drow[b] = tile[a * nb + b];                            \
+            }                                                              \
+        }                                                                  \
+    }                                                                      \
+}
+
+DEFINE_UNPACK(slate_tpu_unpack_tiles_f64, double)
+DEFINE_UNPACK(slate_tpu_unpack_tiles_f32, float)
+
+}  // extern "C"

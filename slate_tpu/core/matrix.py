@@ -217,6 +217,18 @@ class BaseMatrix:
         return dense
 
     def to_numpy(self) -> np.ndarray:
+        # root general views export through the NATIVE tile unpacker when
+        # built (one host pass over the fetched tile array); structured
+        # types and op views need to_dense()'s expansion
+        if (type(self) is Matrix and self.op is Op.NoTrans
+                and self.is_root_view()):
+            from .. import native as _native
+            st = self.storage
+            tiles = np.asarray(jax.device_get(st.data))
+            out = _native.unpack_tiles(tiles, st.m, st.n, st.grid.p,
+                                       st.grid.q)
+            if out is not None:
+                return out
         return np.asarray(jax.device_get(self.to_dense()))
 
     def with_dense(self, dense):

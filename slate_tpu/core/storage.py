@@ -76,8 +76,20 @@ class TileStorage:
 
     @classmethod
     def from_dense(cls, dense, mb, nb, grid: Grid | None = None):
-        """Import a host/global array (ref: Matrix::fromLAPACK, Matrix.hh:344)."""
+        """Import a host/global array (ref: Matrix::fromLAPACK, Matrix.hh:344).
+
+        Host numpy f32/f64 inputs go through the NATIVE tile packer
+        (native/slate_tpu_native.cc, OpenMP across tiles) when built —
+        one memory-bandwidth pass instead of a device reshape/transpose
+        chain; traced/device inputs use the jnp layout ops."""
         grid = grid or Grid(1, 1)
+        if isinstance(dense, np.ndarray) and dense.ndim == 2:
+            from .. import native as _native
+            packed = _native.pack_tiles(dense, mb, nb, grid.p, grid.q)
+            if packed is not None:
+                st = cls(jnp.asarray(packed), dense.shape[0],
+                         dense.shape[1], mb, nb, grid)
+                return st._shard()
         dense = jnp.asarray(dense)
         slate_error(dense.ndim == 2, "from_dense needs a 2D array")
         tiles = layout.tile_dense(dense, mb, nb)

@@ -279,8 +279,14 @@ def stedc(d, e):
     Use float64 (CPU backend) for LAPACK-grade orthogonality; the f32
     path (TPU) uses dtype-calibrated exp/log guards and delivers
     f32-grade (~1e-6 * ||T||) residuals."""
+    import jax
     d = jnp.asarray(d)
     e = jnp.asarray(e)
     if d.shape[0] == 1:
         return d, jnp.ones((1, 1), d.dtype)
-    return _stedc_rec(d, e)
+    # pin true-precision matmuls: the merge gemm Qm = Q0 @ U accumulates
+    # across O(log n) levels, and TPU's default bf16-pass matmul costs
+    # ~3 digits of orthogonality per level (measured ~2e-2 vs ~1e-4 at
+    # n=64 f32) — same discipline as hetrf's recurrence gemms
+    with jax.default_matmul_precision("highest"):
+        return _stedc_rec(d, e)

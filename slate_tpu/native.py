@@ -1,0 +1,112 @@
+"""ctypes binding to the native host runtime (native/slate_tpu_native.cc).
+
+The TPU compute path is JAX/XLA; the native library covers the HOST
+runtime around it — the analog of the reference's C++ storage/layout
+layer and C API tier (ref: MatrixStorage.hh, Tile.hh:707 layoutConvert,
+src/c_api/wrappers.cc): packing user LAPACK column-major buffers into the
+2D block-cyclic tile layout at memory bandwidth (OpenMP across tiles),
+the inverse unpack, and ScaLAPACK descriptor arithmetic.
+
+Build once with ``make -C native``; everything degrades to the pure
+numpy fallback when the .so is absent (the reference's no-MPI stub
+discipline, src/stubs/)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    path = os.path.join(os.path.dirname(__file__), "_native.so")
+    if not os.path.exists(path):
+        _LIB = False
+        return False
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        _LIB = False
+        return False
+    i64 = ctypes.c_int64
+    lib.slate_tpu_native_version.restype = i64
+    lib.slate_tpu_numroc.restype = i64
+    lib.slate_tpu_numroc.argtypes = [i64] * 5
+    for name, ct in (("f64", ctypes.c_double), ("f32", ctypes.c_float)):
+        for op in ("pack", "unpack"):
+            fn = getattr(lib, f"slate_tpu_{op}_tiles_{name}")
+            fn.restype = None
+            fn.argtypes = [ctypes.POINTER(ct)] + [i64] * 7 + \
+                          [ctypes.POINTER(ct)]
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+def version() -> int | None:
+    lib = _load()
+    return int(lib.slate_tpu_native_version()) if lib else None
+
+
+def numroc(n: int, nb: int, iproc: int, isrcproc: int, nprocs: int) -> int:
+    """ScaLAPACK numroc via the native library (numpy fallback)."""
+    lib = _load()
+    if lib:
+        return int(lib.slate_tpu_numroc(n, nb, iproc, isrcproc, nprocs))
+    mydist = (nprocs + iproc - isrcproc) % nprocs
+    nblocks = n // nb
+    out = (nblocks // nprocs) * nb
+    extrablks = nblocks % nprocs
+    if mydist < extrablks:
+        out += nb
+    elif mydist == extrablks:
+        out += n % nb
+    return out
+
+
+_CTYPES = {np.dtype(np.float64): ("f64", ctypes.c_double),
+           np.dtype(np.float32): ("f32", ctypes.c_float)}
+
+
+def pack_tiles(a: np.ndarray, mb: int, nb: int, p: int, q: int):
+    """Host pack: numpy [m, n] (row-major) -> cyclic tile array
+    [p*mtl, q*ntl, mb, nb], one memory pass, no transpose copies.
+    Returns None when the native path cannot take this input (caller
+    falls back to the jnp layout ops)."""
+    lib = _load()
+    if not lib or a.ndim != 2 or a.dtype not in _CTYPES:
+        return None
+    m, n = a.shape
+    Mt, Nt = -(-m // mb), -(-n // nb)
+    mtl, ntl = -(-Mt // p), -(-Nt // q)
+    sfx, ct = _CTYPES[a.dtype]
+    src = np.ascontiguousarray(a)          # no-op for numpy's default order
+    out = np.empty((p * mtl, q * ntl, mb, nb), a.dtype)
+    fn = getattr(lib, f"slate_tpu_pack_tiles_{sfx}")
+    fn(src.ctypes.data_as(ctypes.POINTER(ct)), m, n, n, mb, nb, p, q,
+       out.ctypes.data_as(ctypes.POINTER(ct)))
+    return out
+
+
+def unpack_tiles(tiles: np.ndarray, m: int, n: int, p: int, q: int):
+    """Cyclic tile array -> numpy [m, n] (row-major), one memory pass."""
+    lib = _load()
+    if not lib or tiles.dtype not in _CTYPES:
+        return None
+    mb, nb = tiles.shape[2], tiles.shape[3]
+    sfx, ct = _CTYPES[tiles.dtype]
+    src = np.ascontiguousarray(tiles)
+    out = np.empty((m, n), tiles.dtype)
+    fn = getattr(lib, f"slate_tpu_unpack_tiles_{sfx}")
+    fn(src.ctypes.data_as(ctypes.POINTER(ct)), m, n, n, mb, nb, p, q,
+       out.ctypes.data_as(ctypes.POINTER(ct)))
+    return out

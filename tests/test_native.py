@@ -1,0 +1,90 @@
+"""Native host runtime (native/slate_tpu_native.cc via slate_tpu.native):
+tile pack/unpack equivalence with the jnp layout ops, numroc parity, and
+the from_numpy/to_numpy fast paths.  Builds the library on the fly when a
+toolchain is present; everything else falls back and is skipped."""
+
+import pathlib
+import shutil
+import subprocess
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import native
+from slate_tpu.core import layout
+
+REPO = pathlib.Path(st.__file__).parent.parent
+
+
+@pytest.fixture(scope="module")
+def lib():
+    if not native.available():
+        if shutil.which("g++") is None and shutil.which("c++") is None:
+            pytest.skip("no C++ toolchain")
+        subprocess.run(["make", "-C", str(REPO / "native")], check=True)
+        native._LIB = None                      # force reload
+    if not native.available():
+        pytest.skip("native build failed")
+    return native
+
+
+def test_version(lib):
+    assert lib.version() >= 20260730
+
+
+@pytest.mark.parametrize("shape", [(10, 7, 4, 3, 2, 2), (16, 16, 4, 4, 1, 1),
+                                   (33, 29, 8, 8, 2, 4), (5, 5, 8, 8, 2, 2)])
+@pytest.mark.parametrize("dt", [np.float64, np.float32])
+def test_pack_matches_layout(lib, rng, shape, dt):
+    m, n, mb, nb, p, q = shape
+    a = rng.standard_normal((m, n)).astype(dt)
+    ref = np.asarray(layout.canonical_to_cyclic(
+        layout.tile_dense(jnp.asarray(a), mb, nb), p, q))
+    got = lib.pack_tiles(a, mb, nb, p, q)
+    assert got is not None
+    # tolerance only for the jnp path's transfer rounding; the native
+    # round-trip below is required to be EXACT
+    rtol = 1e-6 if dt == np.float32 else 1e-14
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=0)
+    back = lib.unpack_tiles(got, m, n, p, q)
+    np.testing.assert_array_equal(back, a)
+
+
+def test_numroc_parity(lib):
+    # three independent implementations must agree: the compat tier's pure
+    # Python, native.py's fallback body, and the C library
+    from slate_tpu.compat.scalapack import numroc as py_numroc
+    saved = native._LIB
+    for n in (1, 7, 16, 100):
+        for nb in (1, 3, 8):
+            for np_ in (1, 2, 5):
+                for ip in range(np_):
+                    c_val = lib.numroc(n, nb, ip, 0, np_)
+                    assert py_numroc(n, nb, ip, 0, np_) == c_val
+                    try:
+                        native._LIB = False     # force the Python fallback
+                        assert native.numroc(n, nb, ip, 0, np_) == c_val
+                    finally:
+                        native._LIB = saved
+                assert sum(py_numroc(n, nb, i, 0, np_)
+                           for i in range(np_)) == n
+
+
+def test_from_numpy_uses_native(lib, rng):
+    # the host import path and the jnp path must build identical storage
+    m, n, mb, nb = 23, 17, 8, 8
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, mb, nb)
+    np.testing.assert_array_equal(A.to_numpy(), a)   # native round-trip
+    B = st.Matrix(st.TileStorage.from_dense(jnp.asarray(a), mb, nb))
+    np.testing.assert_allclose(np.asarray(A.storage.data),
+                               np.asarray(B.storage.data), rtol=1e-14)
+
+
+def test_complex_falls_back(lib, rng):
+    a = (rng.standard_normal((8, 8))
+         + 1j * rng.standard_normal((8, 8)))
+    A = st.Matrix.from_numpy(a, 4, 4)               # jnp fallback path
+    np.testing.assert_allclose(A.to_numpy(), a, atol=1e-14)

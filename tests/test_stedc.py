@@ -88,8 +88,12 @@ def test_heev_dc_uses_stedc(rng):
 
 
 def test_stedc_float32(rng):
-    # dtype-calibrated guards: the f32 path (TPU) must deliver f32-grade
-    # accuracy, not overflow the log-space bisection
+    # dtype-calibrated guards: the f32 path must deliver f32-grade
+    # accuracy, not overflow the log-space bisection.  NOTE: conftest pins
+    # the CPU backend, so this covers f32 arithmetic, not TPU matmul
+    # passes — stedc pins default_matmul_precision("highest") internally
+    # precisely because the TPU default bf16-pass merge gemms cost ~2e-2
+    # of orthogonality (measured on-device; CI cannot see that backend)
     n = 80
     d = rng.standard_normal(n).astype(np.float32)
     e = rng.standard_normal(n - 1).astype(np.float32)
