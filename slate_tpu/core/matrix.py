@@ -219,16 +219,20 @@ class BaseMatrix:
     def to_numpy(self) -> np.ndarray:
         # root general views export through the NATIVE tile unpacker when
         # built (one host pass over the fetched tile array); structured
-        # types and op views need to_dense()'s expansion
+        # types and op views need to_dense()'s expansion.  Check the
+        # library and dtype BEFORE fetching — a failed attempt would have
+        # paid the full device->host transfer twice
         if (type(self) is Matrix and self.op is Op.NoTrans
                 and self.is_root_view()):
             from .. import native as _native
             st = self.storage
-            tiles = np.asarray(jax.device_get(st.data))
-            out = _native.unpack_tiles(tiles, st.m, st.n, st.grid.p,
-                                       st.grid.q)
-            if out is not None:
-                return out
+            if (_native.available()
+                    and np.dtype(st.dtype) in _native._CTYPES):
+                tiles = np.asarray(jax.device_get(st.data))
+                out = _native.unpack_tiles(tiles, st.m, st.n, st.grid.p,
+                                           st.grid.q)
+                if out is not None:
+                    return out
         return np.asarray(jax.device_get(self.to_dense()))
 
     def with_dense(self, dense):
@@ -283,9 +287,14 @@ class Matrix(BaseMatrix):
 
     @classmethod
     def from_numpy(cls, a, mb, nb=None, grid=None, kind=TileKind.UserOwned):
-        """Import user data (ref: fromLAPACK, Matrix.hh:58-112)."""
+        """Import user data (ref: fromLAPACK, Matrix.hh:58-112).
+
+        Host numpy arrays are passed through UNconverted so from_dense can
+        take the native one-pass tile packer; jnp.asarray here would hide
+        the numpy-ness and silently fall back to the device layout ops."""
         nb = nb or mb
-        st = TileStorage.from_dense(jnp.asarray(a), mb, nb, grid or Grid(1, 1))
+        a = a if isinstance(a, np.ndarray) else jnp.asarray(a)
+        st = TileStorage.from_dense(a, mb, nb, grid or Grid(1, 1))
         return cls(st, kind=kind)
 
     # ---- structure reinterpretation (ref: conversion ctors) ----
@@ -435,7 +444,8 @@ class BandMatrix(BaseBandMatrix):
 
     @classmethod
     def from_numpy(cls, a, kl, ku, mb, grid=None):
-        st = TileStorage.from_dense(jnp.asarray(a), mb, mb, grid or Grid(1, 1))
+        a = a if isinstance(a, np.ndarray) else jnp.asarray(a)
+        st = TileStorage.from_dense(a, mb, mb, grid or Grid(1, 1))
         return cls(st, kl=kl, ku=ku)
 
 

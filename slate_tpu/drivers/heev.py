@@ -32,7 +32,7 @@ from jax import lax
 from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
-from ..internal.qr import (apply_q_left, build_t, householder_panel,
+from ..internal.qr import (apply_q_left, householder_panel_blocked,
                            householder_vec, phase_of, unit_lower)
 from ..options import (MethodEig, Option, Options, Target, get_option,
                        resolve_target)
@@ -55,8 +55,7 @@ def _he2hb_dense(a, nb: int):
         k1 = min(k0 + nb, n)
         w = k1 - k0
         panel = a[k1:, k0:k1]
-        packed, taus = householder_panel(panel)
-        T = build_t(packed, taus)
+        packed, T = householder_panel_blocked(panel)
         V = unit_lower(packed)                    # [n-k1, w]
         # two-sided her2k-form update of the trailing block
         # (ref: he2hb.cc:438-578 he2hb_hemm/her2k_offdiag kernels):

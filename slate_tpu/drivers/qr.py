@@ -28,8 +28,8 @@ from jax import lax
 from ..core.matrix import Matrix, TriangularMatrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
-from ..internal.qr import (apply_q_left, apply_q_right, build_t,
-                           householder_panel)
+from ..internal.qr import (apply_q_left, apply_q_right,
+                           householder_panel_blocked)
 from ..options import (MethodGels, Options, Target,
                        resolve_target, select_gels_method)
 from ..types import Op, Side, Uplo, is_complex
@@ -112,8 +112,7 @@ def _geqrf_dense_blocked(a, nb: int):
         k1 = min(k0 + nb, r)
         w = k1 - k0
         panel = a[k0:, k0:k1]
-        packed, taus = householder_panel(panel)
-        T = build_t(packed, taus)
+        packed, T = householder_panel_blocked(panel)
         a = a.at[k0:, k0:k1].set(packed)
         if k1 < n:
             trail = apply_q_left(packed, T, a[k0:, k1:], conj_trans=True)

@@ -26,8 +26,9 @@ from jax import lax
 from ..core.matrix import Matrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
-from ..internal.qr import (apply_q_left, apply_q_right, build_t,
-                           householder_panel, householder_vec, phase_of)
+from ..internal.qr import (apply_q_left, apply_q_right,
+                           householder_panel_blocked, householder_vec,
+                           phase_of)
 from ..options import (MethodSvd, Option, Options, Target, get_option,
                        resolve_target)
 from ..types import Op, is_complex
@@ -49,8 +50,7 @@ def _ge2tb_dense(a, nb: int):
         k1 = min(k0 + nb, n)
         w = k1 - k0
         # left QR panel on cols [k0, k1)
-        packed, taus = householder_panel(a[k0:, k0:k1])
-        Tq = build_t(packed, taus)
+        packed, Tq = householder_panel_blocked(a[k0:, k0:k1])
         a = a.at[k0:, k0:k1].set(packed)
         if k1 < n:
             trail = apply_q_left(packed, Tq, a[k0:, k1:], conj_trans=True)
@@ -58,8 +58,7 @@ def _ge2tb_dense(a, nb: int):
             # right LQ panel on rows [k0, k1), cols [k1, n):
             # factor conj(blk)^T = Q_l R_l; blk <- blk conj(Q_l) = [L 0]
             blk = a[k0:k1, k1:]
-            packed_l, taus_l = householder_panel(jnp.conj(blk).T)
-            Tl = build_t(packed_l, taus_l)
+            packed_l, Tl = householder_panel_blocked(jnp.conj(blk).T)
             # merge L (on/below the diagonal) with the reflector rows kept
             # strictly above it — LAPACK gelqf packing: overwriting the
             # whole leading w x w block would clobber the v entries there

@@ -58,19 +58,13 @@ def version() -> int | None:
 
 
 def numroc(n: int, nb: int, iproc: int, isrcproc: int, nprocs: int) -> int:
-    """ScaLAPACK numroc via the native library (numpy fallback)."""
+    """ScaLAPACK numroc via the native library; the fallback IS the compat
+    tier's pure-Python implementation (single source of the arithmetic)."""
     lib = _load()
     if lib:
         return int(lib.slate_tpu_numroc(n, nb, iproc, isrcproc, nprocs))
-    mydist = (nprocs + iproc - isrcproc) % nprocs
-    nblocks = n // nb
-    out = (nblocks // nprocs) * nb
-    extrablks = nblocks % nprocs
-    if mydist < extrablks:
-        out += nb
-    elif mydist == extrablks:
-        out += n % nb
-    return out
+    from .compat.scalapack import numroc as _py_numroc
+    return _py_numroc(n, nb, iproc, isrcproc, nprocs)
 
 
 _CTYPES = {np.dtype(np.float64): ("f64", ctypes.c_double),

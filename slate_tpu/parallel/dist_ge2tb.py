@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
-from ..internal.qr import build_t, householder_panel, unit_lower
+from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
 from .dist_he2hb import larfb_left_local, v_from_gathered
 from .dist_lu import _gather_panel
@@ -90,8 +90,7 @@ def _ge2tb_local(a_loc, Mt: int, Ntn: int, m: int, n: int, p: int, q: int,
             prow = jnp.arange(W0 * nb)
             live = prow < (m - k * nb)
             panel = jnp.where(live[:, None], panel, jnp.zeros_like(panel))
-            packed, taus = householder_panel(panel)
-            Tq = build_t(packed, taus)
+            packed, Tq = householder_panel_blocked(panel)
             Tqs = lax.dynamic_update_slice(Tqs, Tq[None], (ki, zi, zi))
 
             vwin = jnp.roll(unit_lower(packed), shift, axis=0)
@@ -147,8 +146,7 @@ def _ge2tb_local(a_loc, Mt: int, Ntn: int, m: int, n: int, p: int, q: int,
             lrow = jnp.arange(W0n * nb)
             livel = lrow < (n - (k + 1) * nb)
             rpan = jnp.where(livel[:, None], rpan, jnp.zeros_like(rpan))
-            packed_l, taus_l = householder_panel(rpan)
-            Tl = build_t(packed_l, taus_l)
+            packed_l, Tl = householder_panel_blocked(rpan)
             has_lq = (k + 1) * nb < n
             Tl = jnp.where(has_lq, Tl, jnp.zeros_like(Tl))
             Tls = lax.dynamic_update_slice(Tls, Tl[None], (ki, zi, zi))

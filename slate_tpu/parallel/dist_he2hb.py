@@ -43,7 +43,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
-from ..internal.qr import build_t, householder_panel, unit_lower
+from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
 from ..util.trace import span
 from .dist_lu import _gather_panel
@@ -96,8 +96,7 @@ def _he2hb_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
             prow = jnp.arange(W0 * nb)
             live = prow < (n - (k + 1) * nb)     # rows of the active panel
             panel = jnp.where(live[:, None], panel, jnp.zeros_like(panel))
-            packed, taus = householder_panel(panel)
-            Tk = build_t(packed, taus)
+            packed, Tk = householder_panel_blocked(panel)
             Ts = lax.dynamic_update_slice(
                 Ts, Tk[None], (k.astype(jnp.int32), zi, zi))
 

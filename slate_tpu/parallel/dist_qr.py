@@ -37,7 +37,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_along
 from ..core.grid import AXIS_P, AXIS_Q, Grid
-from ..internal.qr import build_t, householder_panel, unit_lower
+from ..internal.qr import (build_t, householder_panel,
+                           householder_panel_blocked, unit_lower)
 
 
 def _panel_tables(k: int, Mt: int, m: int, nb: int, p: int):
@@ -156,13 +157,12 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
                         jnp.zeros_like(pan))
         pan = jnp.roll(pan, -skip, axis=0)
         slab = pan.reshape(mtl * nb, nb)
-        packed, taus = householder_panel(slab)
+        packed, Tr = householder_panel_blocked(slab)
         # only the owner column's panel is real; share it across the row
         packed = bcast_along(jnp.where(c == ck, packed,
                                        jnp.zeros_like(packed)), ck, AXIS_Q)
-        taus = bcast_along(jnp.where(c == ck, taus, jnp.zeros_like(taus)),
-                           ck, AXIS_Q)
-        Tr = build_t(packed, taus)
+        Tr = bcast_along(jnp.where(c == ck, Tr, jnp.zeros_like(Tr)),
+                         ck, AXIS_Q)
         Vr = unit_lower(packed)
         Tloc = Tloc.at[k].set(Tr)
 

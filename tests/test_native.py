@@ -72,11 +72,18 @@ def test_numroc_parity(lib):
                            for i in range(np_)) == n
 
 
-def test_from_numpy_uses_native(lib, rng):
-    # the host import path and the jnp path must build identical storage
+def test_from_numpy_uses_native(lib, rng, monkeypatch):
+    # the public import path must actually REACH the native packer (a
+    # jnp.asarray pre-conversion once made this path dead code), and the
+    # host and jnp paths must build identical storage
     m, n, mb, nb = 23, 17, 8, 8
     a = rng.standard_normal((m, n))
+    calls = []
+    orig = native.pack_tiles
+    monkeypatch.setattr(native, "pack_tiles",
+                        lambda *args: calls.append(1) or orig(*args))
     A = st.Matrix.from_numpy(a, mb, nb)
+    assert calls, "Matrix.from_numpy did not reach native.pack_tiles"
     np.testing.assert_array_equal(A.to_numpy(), a)   # native round-trip
     B = st.Matrix(st.TileStorage.from_dense(jnp.asarray(a), mb, nb))
     np.testing.assert_allclose(np.asarray(A.storage.data),
