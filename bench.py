@@ -221,6 +221,21 @@ def bench_heev(n, nb, iters):
     _emit(f"heev_vals_n{n}_gflops_per_chip", gflops, {"nb": nb})
 
 
+def bench_svd(n, nb, iters):
+    """Two-stage SVD, values only (BASELINE config #5 family): ge2tb band
+    reduction + the MethodSvd.Auto band seam."""
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+
+    def body(carry, a):
+        s = st.svd_vals(_mat(a * (1.0 + carry), nb, nb))
+        return s[0] * 1e-24
+
+    flops = 8.0 * n**3 / 3.0               # ref gesvd reduction count
+    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"svd_vals_n{n}_gflops_per_chip", gflops, {"nb": nb})
+
+
 def main():
     global PEAK, CHIP
     PEAK, CHIP = _chip_peak()
@@ -231,6 +246,7 @@ def main():
         bench_geqrf(m=4096, n=256, nb=128, iters=2)
         bench_gels(m=4096, n=256, nb=128, nrhs=16, iters=2)
         bench_heev(n=512, nb=128, iters=2)
+        bench_svd(n=512, nb=128, iters=2)
         return
     bench_gemm(n=4096, nb=256, iters=50)
     bench_gemm(n=8192, nb=512, iters=20)
@@ -239,6 +255,7 @@ def main():
     bench_geqrf(m=131072, n=1024, nb=256, iters=4)
     bench_gels(m=131072, n=1024, nb=256, nrhs=64, iters=4)
     bench_heev(n=4096, nb=256, iters=3)
+    bench_svd(n=2048, nb=256, iters=3)
 
 
 if __name__ == "__main__":

@@ -226,8 +226,7 @@ class BaseMatrix:
                 and self.is_root_view()):
             from .. import native as _native
             st = self.storage
-            if (_native.available()
-                    and np.dtype(st.dtype) in _native._CTYPES):
+            if _native.available() and _native.supports(st.dtype):
                 tiles = np.asarray(jax.device_get(st.data))
                 out = _native.unpack_tiles(tiles, st.m, st.n, st.grid.p,
                                            st.grid.q)

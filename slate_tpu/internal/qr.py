@@ -105,13 +105,13 @@ def householder_panel_blocked(a, base_w: int = 32):
         return packed, build_t(packed, taus)
     h = w // 2
     p1, T1 = householder_panel_blocked(a[:, :h], base_w)
-    V1 = unit_lower(p1)
     right = apply_q_left(p1, T1, a[:, h:], conj_trans=True)
     p2, T2 = householder_panel_blocked(right[h:], base_w)
     packed = jnp.concatenate(
         [p1, jnp.concatenate([right[:h], p2], axis=0)], axis=1)
-    V2 = jnp.zeros((mm, w - h), a.dtype).at[h:].set(unit_lower(p2))
-    T12 = -T1 @ (jnp.conj(V1).T @ V2) @ T2
+    # V2's top h rows are structurally zero: restrict the gram product to
+    # V1's live rows instead of multiplying 131072-tall zero padding
+    T12 = -T1 @ (jnp.conj(unit_lower(p1)[h:]).T @ unit_lower(p2)) @ T2
     T = jnp.zeros((w, w), a.dtype)
     T = T.at[:h, :h].set(T1).at[h:, h:].set(T2).at[:h, h:].set(T12)
     return packed, T

@@ -52,6 +52,11 @@ def available() -> bool:
     return bool(_load())
 
 
+def supports(dtype) -> bool:
+    """Whether the native pack/unpack kernels handle this dtype."""
+    return np.dtype(dtype) in _CTYPES
+
+
 def version() -> int | None:
     lib = _load()
     return int(lib.slate_tpu_native_version()) if lib else None
