@@ -10,11 +10,11 @@ TPU-first shape mirrors drivers/heev.py:
 - ge2tb: alternating QR (left) and LQ (right) Householder panels — all
   O(mn^2) work in larfb MXU gemms; band result is upper triangular with
   bandwidth nb.
-- tb2bd: bulge chase as ONE lax.scan of alternating right/left kd-window
-  reflectors (the reference's sweep/step task pipeline, tb2bd.cc), with
-  U2/V2 accumulated in the same scan.
-- bidiagonal kernel: XLA's SVD on the assembled bidiagonal — the vendor
-  seam where the reference calls lapack::bdsqr (svd.cc:286).
+- stage-2 seam (MethodSvd): Auto SVDs the stage-1 band directly with the
+  vendor kernel (no chase — see _stage2_svd); Bidiag is the parity route:
+  tb2bd bulge chase as ONE lax.scan of alternating right/left kd-window
+  reflectors (the reference's sweep/step task pipeline, tb2bd.cc) with
+  U2/V2 accumulated in the scan, then the bdsqr-analog seam (svd.cc:286).
 """
 
 from __future__ import annotations
