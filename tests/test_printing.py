@@ -1,0 +1,66 @@
+"""Distributed print driver (ref: src/print.cc) and trace spans."""
+
+import numpy as np
+
+import slate_tpu as st
+from slate_tpu.options import Option
+
+
+def test_format_verbosity_levels(rng):
+    a = rng.standard_normal((6, 5))
+    A = st.Matrix.from_numpy(a, 2, 2)
+    assert st.format_matrix("A", A, {Option.PrintVerbose: 0}) == ""
+    meta = st.format_matrix("A", A, {Option.PrintVerbose: 1})
+    assert "Matrix 6x5" in meta and "tiles 2x2" in meta
+    full = st.format_matrix("A", A, {Option.PrintVerbose: 4})
+    assert "A = [" in full
+    assert "..." not in full                # verbose 4 = no ellipsis
+    # a representative entry renders at the configured precision
+    assert f"{a[0, 0]:.4f}" in full
+
+
+def test_format_band_and_hermitian(rng):
+    n, kd, mb = 8, 2, 4
+    h = rng.standard_normal((n, n))
+    h = (h + h.T) / 2
+    H = st.HermitianMatrix.from_numpy(h, mb)
+    s = st.format_matrix("H", H, {Option.PrintVerbose: 1})
+    assert "HermitianMatrix" in s and "uplo=Lower" in s
+    band = np.where(np.abs(np.subtract.outer(np.arange(n), np.arange(n)))
+                    <= kd, h, 0.0)
+    HB = st.HermitianBandMatrix.from_numpy(band, kd, mb)
+    s2 = st.format_matrix("HB", HB, {Option.PrintVerbose: 1})
+    assert "HermitianBandMatrix" in s2 and "kd=2" in s2
+
+
+def test_print_matrix_stdout(rng, capsys):
+    A = st.Matrix.from_numpy(rng.standard_normal((4, 4)), 2, 2)
+    st.print_matrix("A", A, {Option.PrintVerbose: 1})
+    out = capsys.readouterr().out
+    assert "Matrix 4x4" in out
+
+
+def test_trace_span_names_phases(rng, tmp_path):
+    # the annotate/span discipline labels driver phases: a captured jax
+    # profile of a solve contains the slate.* names (the Trace.hh analog)
+    import glob
+    import gzip
+
+    import jax
+    a = rng.standard_normal((16, 16))
+    spd = a @ a.T + 16 * np.eye(16)
+    A = st.HermitianMatrix.from_numpy(spd, 4)
+    B = st.Matrix.from_numpy(a[:, :2], 4, 4)
+    with jax.profiler.trace(str(tmp_path)):
+        _, X = st.posv(A, B)
+        X.to_numpy()
+    blobs = glob.glob(str(tmp_path / "**" / "*.pb*"), recursive=True) + \
+        glob.glob(str(tmp_path / "**" / "*.json*"), recursive=True)
+    found = set()
+    for f in blobs:
+        raw = gzip.open(f, "rb").read() if f.endswith(".gz") else \
+            open(f, "rb").read()
+        for name in (b"slate.posv", b"slate.potrf", b"slate.trsm"):
+            if name in raw:
+                found.add(name.decode())
+    assert "slate.posv" in found and "slate.potrf" in found, found
