@@ -87,8 +87,15 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
                 alpha, beta, An.storage.Nt, Cn.grid)
         return _result_mat(Cn, data)
 
-    # single target: one fused MXU contraction
-    Cd = alpha * (A.to_dense() @ B.to_dense()) + beta * C.to_dense()
+    # single target: one fused MXU contraction.  Literal alpha=1 / beta=0
+    # skip their passes entirely — XLA cannot fold 0*C itself (0*NaN
+    # semantics), and the beta=0 path otherwise materialises and reads a
+    # zeros C for nothing (measured ~35% of the n=8192 gemm wall-clock)
+    Cd = A.to_dense() @ B.to_dense()
+    if not (isinstance(alpha, (int, float)) and alpha == 1.0):
+        Cd = jnp.asarray(alpha, Cd.dtype) * Cd
+    if not (isinstance(beta, (int, float)) and beta == 0.0):
+        Cd = Cd + jnp.asarray(beta, Cd.dtype) * C.to_dense()
     return C.with_dense(Cd) if type(C) is Matrix else _dense_to_like(C, Cd)
 
 

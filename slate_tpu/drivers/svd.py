@@ -37,49 +37,76 @@ from ..util.trace import annotate
 
 # ---------------------------------------------------------------- stage 1
 
-def _ge2tb_dense(a, nb: int):
-    """Dense m x n (m >= n) -> upper triangular band of bandwidth nb.
+def _ge2tb_scan(a, nb: int):
+    """Dense m x n (m >= n) -> upper triangular band of bandwidth nb, as
+    ONE lax.scan step per QR+LQ panel pair with uniform shapes.
 
-    Returns (a_packed, Tq, Tl): QR panel reflectors packed below the
-    diagonal, LQ panel reflectors packed right of the band (conjugated,
-    row-space), T triangles for both chains (ref: ge2tb.cc stores U and V
-    households the same way)."""
+    The reference's ge2tb alternates shrinking QR and LQ panels
+    (ref: src/ge2tb.cc); a statically-unrolled translation compiles K
+    copies of the body (the compile-size blowup fixed in heev._he2hb_scan
+    — same re-anchoring discipline here).  After each pair the trailing
+    block moves to the origin, so every step is shape-identical; rows and
+    columns past the live block are exactly zero and reflectors there are
+    identity (tau = 0).
+
+    Returns (Vqs, Tqs, Vls, Tls, Ds, Ss): QR panels [K, Mp, nb] (panel
+    k's local row 0 = global row k nb), LQ panels [K, Np-nb, nb]
+    conjugate-transposed to column form (local row 0 = global col
+    (k+1) nb), the T triangles, band diagonal tiles Ds [K, nb, nb] (R in
+    the triu) and superdiagonal tiles Ss [K, nb, nb] (L in the tril).
+    Mp = ceil(m/nb) nb, Np = ceil(n/nb) nb, K = Np/nb."""
     m, n = a.shape
-    Tqs, Tls = [], []
-    for k0 in range(0, n, nb):
-        k1 = min(k0 + nb, n)
-        w = k1 - k0
-        # left QR panel on cols [k0, k1)
-        packed, Tq = householder_panel_blocked(a[k0:, k0:k1])
-        a = a.at[k0:, k0:k1].set(packed)
-        if k1 < n:
-            trail = apply_q_left(packed, Tq, a[k0:, k1:], conj_trans=True)
-            a = a.at[k0:, k1:].set(trail)
-            # right LQ panel on rows [k0, k1), cols [k1, n):
-            # factor conj(blk)^T = Q_l R_l; blk <- blk conj(Q_l) = [L 0]
-            blk = a[k0:k1, k1:]
-            packed_l, Tl = householder_panel_blocked(jnp.conj(blk).T)
-            # merge L (on/below the diagonal) with the reflector rows kept
-            # strictly above it — LAPACK gelqf packing: overwriting the
-            # whole leading w x w block would clobber the v entries there
-            ell = jnp.conj(jnp.triu(packed_l)).T           # [w, nk] lower trap
-            vrows = jnp.conj(packed_l).T                   # [w, nk]
-            iw = jnp.arange(w)[:, None]
-            jk = jnp.arange(a.shape[1] - k1)[None, :]
-            newblk = jnp.where(jk <= iw, ell, vrows)
-            a = a.at[k0:k1, k1:].set(newblk)
-            # trailing right update: C <- C Q_l  (blk = R^H Q_l^H, so
-            # right-multiplying by Q_l yields [L 0] with L = R^H)
-            tr = apply_q_right(packed_l, Tl, a[k1:, k1:], conj_trans=False)
-            a = a.at[k1:, k1:].set(tr)
-        else:
-            Tl = jnp.zeros((w, w), a.dtype)
-        if w < nb:
-            Tq = jnp.zeros((nb, nb), Tq.dtype).at[:w, :w].set(Tq)
-            Tl = jnp.zeros((nb, nb), Tl.dtype).at[:w, :w].set(Tl)
-        Tqs.append(Tq)
-        Tls.append(Tl)
-    return a, jnp.stack(Tqs), jnp.stack(Tls)
+    Mp = -(-m // nb) * nb
+    Np = -(-n // nb) * nb
+    K = Np // nb
+    ap = jnp.zeros((Mp, Np), a.dtype).at[:m, :n].set(a)
+    if Np == nb:
+        # single block column: pure QR, no LQ side at all
+        packed_q, Tq = householder_panel_blocked(ap)
+        return (packed_q[None], Tq[None],
+                jnp.zeros((0, 1, nb), a.dtype),
+                jnp.zeros((0, nb, nb), a.dtype),
+                packed_q[None, :nb, :nb], jnp.zeros((1, nb, nb), a.dtype))
+
+    iw = jnp.arange(nb)[:, None]
+    jk = jnp.arange(Np - nb)[None, :]
+
+    def step(A, _):
+        # left QR panel on the leading nb columns (zero tail rows inert)
+        packed_q, Tq = householder_panel_blocked(A[:, :nb])
+        trail = apply_q_left(packed_q, Tq, A[:, nb:], conj_trans=True)
+        D = packed_q[:nb, :nb]                   # R -> band diag tile
+        # right LQ panel on the leading nb rows of the trailing columns:
+        # factor conj(blk)^T = Q_l R_l; blk <- blk conj(Q_l) = [L 0]
+        blk = trail[:nb, :]                      # [nb, Np - nb]
+        packed_l, Tl = householder_panel_blocked(jnp.conj(blk).T)
+        # band superdiag tile: L (= R_l^H) on/below the diagonal with the
+        # reflector v entries strictly above (LAPACK gelqf packing)
+        ell = jnp.conj(jnp.triu(packed_l)).T     # [nb, Np - nb]
+        vrows = jnp.conj(packed_l).T
+        newblk = jnp.where(jk <= iw, ell, vrows)
+        S = newblk[:, :nb]
+        # trailing right update, then re-anchor to the origin
+        tr = apply_q_right(packed_l, Tl, trail[nb:, :], conj_trans=False)
+        A_next = jnp.zeros_like(A).at[: Mp - nb, : Np - nb].set(tr)
+        return A_next, (packed_q, Tq, packed_l, Tl, D, S)
+
+    _, (Vqs, Tqs, Vls, Tls, Ds, Ss) = lax.scan(step, ap, None, length=K)
+    return Vqs, Tqs, Vls, Tls, Ds, Ss
+
+
+def _band_upper_from_stacks(Ds, Ss, n: int, nb: int):
+    """Dense upper band from the ge2tb scan's band tiles: two vectorized
+    tile scatters + one untile (single-target twin of
+    _band_upper_from_tiles)."""
+    from ..core import layout
+    K = Ds.shape[0]
+    g = jnp.arange(K)
+    tiles = jnp.zeros((K, K, nb, nb), Ds.dtype).at[g, g].set(jnp.triu(Ds))
+    if K > 1:
+        tiles = tiles.at[g[:-1], g[:-1] + 1].set(jnp.tril(Ss[:-1]))
+    bd = layout.untile_dense(tiles, K * nb, K * nb)
+    return _band_upper_of(bd[:n, :n], n, nb)
 
 
 def _band_upper_of(a_packed, n: int, kd: int):
@@ -239,39 +266,20 @@ def _stage2_svd(band, nb: int, jobu: bool, opts: Options | None):
     return s, Un, Vn
 
 
-def _unmbr_ge2tb_u(a_packed, Tqs, nb: int, Z):
-    """Z <- Q_qr Z (ref: unmbr_ge2tb U side): QR panels descending."""
-    m = a_packed.shape[0]
+def _unmbr_ge2tb_u(Vqs, Tqs, nb: int, Z):
+    """Z <- Q_qr Z (ref: unmbr_ge2tb U side): QR panels descending;
+    panel k's reflectors start at global row k nb.  Z has Mp rows."""
+    from ..internal.qr import rolled_apply
     K = Tqs.shape[0]
-    n = min(a_packed.shape[1], K * nb)
-    for idx in range(K - 1, -1, -1):
-        k0 = idx * nb
-        k1 = min(k0 + nb, n)
-        w = k1 - k0
-        pk = a_packed[k0:, k0:k1]
-        Tk = Tqs[idx][:w, :w]
-        Z = Z.at[k0:, :].set(apply_q_left(pk, Tk, Z[k0:, :],
-                                          conj_trans=False))
-    return Z
+    return rolled_apply(Vqs, Tqs, jnp.arange(K) * nb, Z)
 
 
-def _unmbr_ge2tb_v(a_packed, Tls, nb: int, Z):
-    """Z <- V1 Z with V1 = W_0 W_1 ... (ref: unmbr_ge2tb V side):
-    A = U1 Band V1^H where each W_k = Q_lq_k acts on rows k1: (the LQ
-    reflectors stored conjugated strictly above the band's L block)."""
-    n = Z.shape[0]
+def _unmbr_ge2tb_v(Vls, Tls, nb: int, Z):
+    """Z <- V1 Z with V1 = W_0 W_1 ... (ref: unmbr_ge2tb V side): each
+    W_k = Q_lq_k acts on global rows (k+1) nb and below.  Z has Np rows."""
+    from ..internal.qr import rolled_apply
     K = Tls.shape[0]
-    for idx in range(K - 1, -1, -1):
-        k0 = idx * nb
-        k1 = min(k0 + nb, n)
-        w = k1 - k0
-        if k1 >= n:
-            continue
-        pk = jnp.conj(a_packed[k0:k1, k1:]).T         # [(n-k1), w] packed
-        Tk = Tls[idx][:w, :w]
-        Zs = apply_q_left(pk, Tk, Z[k1:, :], conj_trans=False)
-        Z = Z.at[k1:, :].set(Zs)
-    return Z
+    return rolled_apply(Vls, Tls, (jnp.arange(K) + 1) * nb, Z)
 
 
 @annotate("slate.svd")
@@ -291,15 +299,18 @@ def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
         return _svd_mesh(A, opts, jobu)
     nb = A.nb
     ad = A.to_dense()
-    packed, Tqs, Tls = _ge2tb_dense(ad, nb)
-    band = _band_upper_of(packed, n, nb)
+    Vqs, Tqs, Vls, Tls, Ds, Ss = _ge2tb_scan(ad, nb)
+    band = _band_upper_from_stacks(Ds, Ss, n, nb)
     s, Un, Vn = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
         return s, None, None
-    Ufull = jnp.zeros((m, n), packed.dtype).at[:n, :n].set(
-        Un.astype(packed.dtype))
-    Ufull = _unmbr_ge2tb_u(packed, Tqs, nb, Ufull)
-    Vfull = _unmbr_ge2tb_v(packed, Tls, nb, Vn.astype(packed.dtype))
+    dt = ad.dtype
+    Mp = Vqs.shape[1]
+    Np = -(-n // nb) * nb
+    Upad = jnp.zeros((Mp, n), dt).at[:n, :n].set(Un.astype(dt))
+    Ufull = _unmbr_ge2tb_u(Vqs, Tqs, nb, Upad)[:m]
+    Vpad = jnp.zeros((Np, n), dt).at[:n].set(Vn.astype(dt))
+    Vfull = _unmbr_ge2tb_v(Vls, Tls, nb, Vpad)[:n]
     g = A.grid
     Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
     Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
@@ -311,18 +322,20 @@ def _band_upper_from_tiles(st, n: int, nb: int):
     diagonal tiles + tril of superdiagonal tiles, gathered straight from
     the cyclic data (the analog of TriangularBandMatrix::ge2tbGather,
     ref: svd.cc:153-160 — only the O(n nb) band tiles leave the mesh)."""
+    from ..core import layout
     from .heev import _band_diag_tiles
     Ntn = -(-n // nb)
     dd = _band_diag_tiles(st, 0)[:Ntn]
-    ss = _band_diag_tiles(st, -1)                 # tiles (g, g+1)
     npad = Ntn * nb
-    bd = jnp.zeros((npad, npad), st.dtype)
-    for g in range(Ntn):
-        bd = bd.at[g * nb:(g + 1) * nb, g * nb:(g + 1) * nb].set(
-            jnp.triu(dd[g]))
-        if g + 1 < Ntn:
-            bd = bd.at[g * nb:(g + 1) * nb,
-                       (g + 1) * nb:(g + 2) * nb].set(jnp.tril(ss[g]))
+    g = jnp.arange(Ntn)
+    # two vectorized tile scatters + one untile (not an O(Nt) unrolled
+    # chain of dense updates — same fix as heev._band_from_tiles)
+    tiles = jnp.zeros((Ntn, Ntn, nb, nb), st.dtype).at[g, g].set(
+        jnp.triu(dd))
+    if Ntn > 1:
+        ss = _band_diag_tiles(st, -1)[:Ntn - 1]   # tiles (g, g+1)
+        tiles = tiles.at[g[:-1], g[:-1] + 1].set(jnp.tril(ss))
+    bd = layout.untile_dense(tiles, npad, npad)
     return _band_upper_of(bd[:n, :n], n, nb)
 
 

@@ -181,3 +181,30 @@ def apply_q_right(packed, T, C, conj_trans: bool):
     W = C @ V                                        # [nr, w]
     Tm = jnp.conj(T).T if conj_trans else T
     return C - (W @ Tm) @ jnp.conj(V).T
+
+
+def rolled_apply(Vstack, Tstack, offsets, Z):
+    """Z <- (prod_k Q_k) Z over a reverse lax.scan of stacked panels.
+
+    Shared back-transform engine for the scan-form two-stage reductions
+    (heev he2hb / svd ge2tb; ref: src/unmtr_he2hb.cc, unmbr_ge2tb).
+    Panel k is stored from local row 0; its true position is
+    ``offsets[k]`` rows down in Z, so each step zero-pads to Z's height
+    and rolls — the panels' zero tails wrap to the top, landing exactly
+    on the rows Q_k must not touch."""
+    K = Tstack.shape[0]
+    if K == 0:
+        return Z
+    pad_rows = Z.shape[0]
+
+    def step(Z, xs):
+        packed, T, off = xs
+        V = unit_lower(packed)
+        Vfull = jnp.zeros((pad_rows, V.shape[1]), V.dtype)
+        Vfull = Vfull.at[: V.shape[0]].set(V)
+        Vr = jnp.roll(Vfull, off, axis=0)
+        Z = Z - Vr @ (T @ (jnp.conj(Vr).T @ Z))
+        return Z, None
+
+    Z, _ = lax.scan(step, Z, (Vstack[::-1], Tstack[::-1], offsets[::-1]))
+    return Z

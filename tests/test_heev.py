@@ -151,19 +151,26 @@ def test_hb2st_public(rng):
     np.testing.assert_allclose(q2 @ T @ q2.conj().T, band, atol=1e-11)
 
 
-def test_hegv(rng):
+@pytest.mark.parametrize("itype", [1, 2, 3])
+def test_hegv(rng, itype):
+    # the three generalized problems (ref: src/hegv.cc:22-35, hegst.cc:40-41)
     n, nb = 12, 4
     a = herm(rng, n)
     bmat = rng.standard_normal((n, n))
     b = bmat @ bmat.T + n * np.eye(n)
     A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower)
     B = st.HermitianMatrix.from_numpy(b, nb, st.Uplo.Lower)
-    w, X = st.hegv(A, B)
+    w, X = st.hegv(A, B, itype=itype)
     w, x = np.asarray(w), X.to_numpy()
     import scipy.linalg
-    wref = scipy.linalg.eigh(a, b, eigvals_only=True)
+    wref = scipy.linalg.eigh(a, b, type=itype, eigvals_only=True)
     np.testing.assert_allclose(np.sort(w), wref, atol=1e-9)
-    np.testing.assert_allclose(a @ x, b @ x @ np.diag(w), atol=1e-9)
+    if itype == 1:
+        np.testing.assert_allclose(a @ x, b @ x @ np.diag(w), atol=1e-9)
+    elif itype == 2:
+        np.testing.assert_allclose(a @ (b @ x), x @ np.diag(w), atol=1e-8)
+    else:
+        np.testing.assert_allclose(b @ (a @ x), x @ np.diag(w), atol=1e-8)
 
 
 def test_heev_uplo_upper(rng):
