@@ -155,8 +155,10 @@ def bench_gesv(n, nb, nrhs, iters):
     b = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
     # CALU tournament pivoting — BASELINE config #3 specifies the tntpiv
     # variant (and its bounded-height chunk LUs fit TPU scoped VMEM, which
-    # XLA's monolithic tall-panel LU custom call does not at this size)
-    opts = {st.Option.MethodLU: st.MethodLU.CALU}
+    # XLA's monolithic tall-panel LU custom call does not at this size).
+    # Depth=4 flattens the reduction tree to ONE batched merge level —
+    # each level is a latency-bound batched LU, so fewer levels win.
+    opts = {st.Option.MethodLU: st.MethodLU.CALU, st.Option.Depth: 4}
 
     def body(carry, a, b):
         A = _mat(a * (1.0 + carry), nb, nb)

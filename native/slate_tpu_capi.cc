@@ -1,0 +1,110 @@
+/* Embedded-interpreter driver C API for slate_tpu.
+ *
+ * Analog of the reference's generated driver C tier
+ * (ref: src/c_api/wrappers.cc:1-1307, include/slate/c_api/wrappers.h):
+ * C programs call slate_tpu_dgesv / dposv / dgels / dsyev / dgesvd with
+ * raw row-major buffers.  The reference's C API wraps a C++ runtime
+ * in-process; here the runtime is the JAX program layer, so this shim
+ * embeds CPython, imports slate_tpu.compat.capi once, and forwards
+ * buffer POINTERS (as integers) plus dimensions — the Python side wraps
+ * them with numpy and runs the real drivers on whatever backend JAX has.
+ *
+ * Build: native/Makefile target libslate_tpu_capi.so (links libpython).
+ * The embedding process must have slate_tpu importable (PYTHONPATH).
+ */
+#include <Python.h>
+#include <stdint.h>
+
+#include "slate_tpu_capi.h"
+
+static PyObject* g_mod = NULL;
+
+int slate_tpu_init(void) {
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  PyGILState_STATE g = PyGILState_Ensure();
+  if (g_mod == NULL) {
+    g_mod = PyImport_ImportModule("slate_tpu.compat.capi");
+    if (g_mod == NULL) PyErr_Print();
+  }
+  int rc = (g_mod == NULL) ? 1 : 0;
+  PyGILState_Release(g);
+  return rc;
+}
+
+void slate_tpu_finalize(void) {
+  if (g_mod != NULL) {
+    PyGILState_STATE g = PyGILState_Ensure();
+    Py_CLEAR(g_mod);
+    PyGILState_Release(g);
+  }
+}
+
+/* Call capi.<name>(...) -> int rc; returns 1 on any Python error. */
+static int call_rc(const char* name, const char* fmt, ...) {
+  if (g_mod == NULL && slate_tpu_init() != 0) return 1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  int rc = 1;
+  if (args != NULL) {
+    PyObject* fn = PyObject_GetAttrString(g_mod, name);
+    if (fn != NULL) {
+      PyObject* res = PyObject_CallObject(fn, args);
+      if (res != NULL) {
+        rc = (int)PyLong_AsLong(res);
+        Py_DECREF(res);
+      }
+      Py_DECREF(fn);
+    }
+    Py_DECREF(args);
+  }
+  if (PyErr_Occurred()) PyErr_Print();
+  PyGILState_Release(g);
+  return rc;
+}
+
+int slate_tpu_dgesv(int64_t n, int64_t nrhs, const double* a, int64_t lda,
+                    const double* b, int64_t ldb, double* x, int64_t ldx,
+                    int64_t nb) {
+  return call_rc("dgesv", "(LLKLKLKLL)", (long long)n, (long long)nrhs,
+                 (unsigned long long)(uintptr_t)a, (long long)lda,
+                 (unsigned long long)(uintptr_t)b, (long long)ldb,
+                 (unsigned long long)(uintptr_t)x, (long long)ldx,
+                 (long long)nb);
+}
+
+int slate_tpu_dposv(int64_t n, int64_t nrhs, const double* a, int64_t lda,
+                    const double* b, int64_t ldb, double* x, int64_t ldx,
+                    int64_t nb) {
+  return call_rc("dposv", "(LLKLKLKLL)", (long long)n, (long long)nrhs,
+                 (unsigned long long)(uintptr_t)a, (long long)lda,
+                 (unsigned long long)(uintptr_t)b, (long long)ldb,
+                 (unsigned long long)(uintptr_t)x, (long long)ldx,
+                 (long long)nb);
+}
+
+int slate_tpu_dgels(int64_t m, int64_t n, int64_t nrhs, const double* a,
+                    int64_t lda, const double* b, int64_t ldb, double* x,
+                    int64_t ldx, int64_t nb) {
+  return call_rc("dgels", "(LLLKLKLKLL)", (long long)m, (long long)n,
+                 (long long)nrhs, (unsigned long long)(uintptr_t)a,
+                 (long long)lda, (unsigned long long)(uintptr_t)b,
+                 (long long)ldb, (unsigned long long)(uintptr_t)x,
+                 (long long)ldx, (long long)nb);
+}
+
+int slate_tpu_dsyev(int64_t n, const double* a, int64_t lda, double* w,
+                    int64_t nb) {
+  return call_rc("dsyev", "(LKLKL)", (long long)n,
+                 (unsigned long long)(uintptr_t)a, (long long)lda,
+                 (unsigned long long)(uintptr_t)w, (long long)nb);
+}
+
+int slate_tpu_dgesvd(int64_t m, int64_t n, const double* a, int64_t lda,
+                     double* s, int64_t nb) {
+  return call_rc("dgesvd", "(LLKLKL)", (long long)m, (long long)n,
+                 (unsigned long long)(uintptr_t)a, (long long)lda,
+                 (unsigned long long)(uintptr_t)s, (long long)nb);
+}

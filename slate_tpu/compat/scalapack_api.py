@@ -1,0 +1,130 @@
+"""ScaLAPACK-style routine entry points over descriptor + local arrays.
+
+Analog of the reference's scalapack_api tier (ref:
+scalapack_api/scalapack_gemm.cc:24-38 slate_pdgemm and the pdgesv /
+pdpotrf / pdgeqrf / pdsyev wrapper files, each of which converts the
+caller's (descriptor, local array) pairs into framework matrices, runs
+the native driver, and writes results back in ScaLAPACK layout).
+
+Each ``pd*`` function here takes the classic 9-integer descriptor plus a
+``{(pr, pc): local array}`` mapping per matrix (the layout
+compat/scalapack.py's ``to_scalapack`` emits and a real ScaLAPACK
+program holds), runs the corresponding slate_tpu driver on ``grid``, and
+returns results converted back with ``to_scalapack``.  Only full-matrix
+operations (IA = JA = 1 in ScaLAPACK terms) and RSRC = CSRC = 0 are
+supported, matching the subset the reference's wrappers assert before
+delegating (scalapack_api/scalapack_slate.hh).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.grid import Grid
+from ..core.matrix import HermitianMatrix, Matrix
+from ..exceptions import slate_error
+from ..types import Uplo
+from .scalapack import from_scalapack, to_scalapack
+
+
+def _mat(desc, locals_, grid: Grid) -> Matrix:
+    return from_scalapack(desc, locals_, grid)
+
+
+def _trans_mat(trans: str, A: Matrix):
+    t = trans.lower()
+    slate_error(t in ("n", "t", "c"), "trans must be 'n', 't' or 'c'")
+    if t == "n":
+        return A
+    return A.transpose() if t == "t" else A.conj_transpose()
+
+
+def pdgemm(transa, transb, m, n, k, alpha, desca, a_locals, descb,
+           b_locals, beta, descc, c_locals, grid: Grid):
+    """C = alpha op(A) op(B) + beta C (ref: scalapack_api/
+    scalapack_gemm.cc slate_pdgemm).  Returns (descc, c_locals)."""
+    from ..drivers.blas3 import gemm
+    A = _trans_mat(transa, _mat(desca, a_locals, grid))
+    B = _trans_mat(transb, _mat(descb, b_locals, grid))
+    C = _mat(descc, c_locals, grid)
+    slate_error((A.m, A.n, B.n) == (m, k, n), "pdgemm: dims vs descriptors")
+    out = gemm(alpha, A, B, beta, C)
+    return to_scalapack(out)
+
+
+def pdgesv(n, nrhs, desca, a_locals, descb, b_locals, grid: Grid):
+    """Solve A X = B by LU (ref: scalapack_api/scalapack_gesv.cc).
+    Returns (descx, x_locals)."""
+    from ..drivers.lu import gesv
+    A = _mat(desca, a_locals, grid)
+    B = _mat(descb, b_locals, grid)
+    slate_error(A.m == n and B.n == nrhs, "pdgesv: dims vs descriptors")
+    _, X = gesv(A, B)
+    return to_scalapack(X)
+
+
+def pdpotrf(uplo, n, desca, a_locals, grid: Grid):
+    """Cholesky factor (ref: scalapack_api/scalapack_potrf.cc).  Returns
+    (desc, locals) of the triangular factor (L for 'l', U for 'u')."""
+    from ..drivers.cholesky import potrf
+    up = Uplo.Lower if str(uplo).lower().startswith("l") else Uplo.Upper
+    A = HermitianMatrix._from_view(_mat(desca, a_locals, grid), up)
+    slate_error(A.m == n, "pdpotrf: dims vs descriptor")
+    L = potrf(A)
+    return to_scalapack(L.general())
+
+
+def pdposv(uplo, n, nrhs, desca, a_locals, descb, b_locals, grid: Grid):
+    """Hermitian positive-definite solve (ref: scalapack_api/
+    scalapack_posv.cc).  Returns (descx, x_locals)."""
+    from ..drivers.cholesky import posv
+    up = Uplo.Lower if str(uplo).lower().startswith("l") else Uplo.Upper
+    A = HermitianMatrix._from_view(_mat(desca, a_locals, grid), up)
+    B = _mat(descb, b_locals, grid)
+    slate_error(A.m == n and B.n == nrhs, "pdposv: dims vs descriptors")
+    _, X = posv(A, B)
+    return to_scalapack(X)
+
+
+def pdgels(m, n, nrhs, desca, a_locals, descb, b_locals, grid: Grid):
+    """Least squares min ||A X - B|| (ref: scalapack_api/
+    scalapack_gels.cc).  Returns (descx, x_locals)."""
+    from ..drivers.qr import gels
+    A = _mat(desca, a_locals, grid)
+    B = _mat(descb, b_locals, grid)
+    slate_error((A.m, A.n, B.n) == (m, n, nrhs),
+                "pdgels: dims vs descriptors")
+    X = gels(A, B)
+    return to_scalapack(X)
+
+
+def pdsyev(jobz, uplo, n, desca, a_locals, grid: Grid):
+    """Symmetric eigendecomposition (ref: scalapack_api/
+    scalapack_heev.cc).  Returns (w, descz, z_locals) — z parts None for
+    jobz='n'."""
+    from ..drivers.heev import heev
+    up = Uplo.Lower if str(uplo).lower().startswith("l") else Uplo.Upper
+    A = HermitianMatrix._from_view(_mat(desca, a_locals, grid), up)
+    slate_error(A.m == n, "pdsyev: dims vs descriptor")
+    want_z = str(jobz).lower().startswith("v")
+    w, Z = heev(A, jobz=want_z)
+    if not want_z:
+        return np.asarray(w), None, None
+    descz, z_locals = to_scalapack(Z)
+    return np.asarray(w), descz, z_locals
+
+
+def pdgesvd(jobu, m, n, desca, a_locals, grid: Grid):
+    """SVD (ref: scalapack_api/scalapack_gesvd.cc).  Returns
+    (s, descu, u_locals, descvt, vt_locals) — U/V parts None for
+    jobu='n'."""
+    from ..drivers.svd import svd
+    A = _mat(desca, a_locals, grid)
+    slate_error((A.m, A.n) == (m, n), "pdgesvd: dims vs descriptor")
+    want_uv = str(jobu).lower().startswith("v")
+    s, U, V = svd(A, jobu=want_uv)
+    if not want_uv:
+        return np.asarray(s), None, None, None, None
+    descu, u_locals = to_scalapack(U)
+    descvt, vt_locals = to_scalapack(V.conj_transpose())
+    return np.asarray(s), descu, u_locals, descvt, vt_locals

@@ -95,16 +95,10 @@ def _he2hb_scan(a, nb: int):
 
 
 def _band_from_stacks(Ds, Ss, n: int, nb: int):
-    """Dense Hermitian band from the he2hb scan's band tiles: two
-    vectorized tile scatters + one untile (single-target twin of
-    _band_from_tiles)."""
-    from ..core import layout
-    Mt = Ds.shape[0]
-    g = jnp.arange(Mt)
-    tiles = jnp.zeros((Mt, Mt, nb, nb), Ds.dtype).at[g, g].set(Ds)
-    if Mt > 1:
-        tiles = tiles.at[g[:-1] + 1, g[:-1]].set(jnp.triu(Ss))
-    bd = layout.untile_dense(tiles, Mt * nb, Mt * nb)
+    """Dense Hermitian band from the he2hb scan's band tiles
+    (single-target twin of _band_from_tiles)."""
+    from ..core.layout import assemble_band
+    bd = assemble_band(Ds, jnp.triu(Ss), lower=True)
     return _band_of(bd[:n, :n], nb)
 
 
@@ -142,19 +136,16 @@ def _band_from_tiles(st, n: int, nb: int):
     (the analog of HermitianBandMatrix::he2hbGather, ref: heev.cc:109-111 —
     only the O(n nb) band tiles leave the mesh).
 
-    TWO vectorized tile scatters + one untile — not an O(Mt) unrolled chain
-    of full-matrix updates (at n=30k/nb=512 that chain was ~60 sequential
-    dense writes in the compiled program)."""
-    from ..core import layout
+    Two vectorized tile scatters + one untile (core/layout.py
+    assemble_band) — not an O(Mt) unrolled chain of full-matrix updates
+    (at n=30k/nb=512 that chain was ~60 sequential dense writes in the
+    compiled program)."""
+    from ..core.layout import assemble_band
     Mt = st.Mt
     dd = _band_diag_tiles(st, 0)                  # [Mt, nb, nb]
-    npad = Mt * nb
-    g = jnp.arange(Mt)
-    tiles = jnp.zeros((Mt, Mt, nb, nb), st.dtype).at[g, g].set(dd)
-    if Mt > 1:
-        ss = _band_diag_tiles(st, 1)              # [Mt-1] tiles (g+1, g)
-        tiles = tiles.at[g[:-1] + 1, g[:-1]].set(jnp.triu(ss))
-    bd = layout.untile_dense(tiles, npad, npad)
+    ss = (jnp.triu(_band_diag_tiles(st, 1)) if Mt > 1
+          else jnp.zeros((0, nb, nb), st.dtype))  # tiles (g+1, g)
+    bd = assemble_band(dd, ss, lower=True)
     return _band_of(bd[:n, :n], nb)
 
 
@@ -236,16 +227,18 @@ def _hb2st(band, kd: int, want_q: bool):
 
 # ---------------------------------------------------------------- driver
 
-def _tridiag_eig(d, e, want_z: bool, opts: Options | None = None):
+def _tridiag_eig(d, e, want_z: bool, opts: Options | None = None,
+                 grid=None):
     """Tridiagonal kernel seam (ref: heev.cc:141-153 steqr2/stedc
     dispatch): MethodEig.DC runs the native divide & conquer
-    (drivers/stedc.py — merge work is MXU gemms, the reference's default);
+    (drivers/stedc.py — merge work is MXU gemms, the reference's default,
+    with merge gemms row-distributed when ``grid`` carries a mesh);
     MethodEig.QR is the vendor seam (XLA eigh of the assembled T, the
     steqr2 analog)."""
     meth = get_option(opts, Option.MethodEig)
     if meth is MethodEig.DC and want_z and d.shape[0] > 1:
         from .stedc import stedc
-        return stedc(d, e)
+        return stedc(d, e, grid)
     n = d.shape[0]
     T = (jnp.diag(d) + jnp.diag(e, -1) + jnp.diag(e, 1)
          if n > 1 else jnp.diag(d))
@@ -254,7 +247,8 @@ def _tridiag_eig(d, e, want_z: bool, opts: Options | None = None):
     return jnp.linalg.eigvalsh(T), None
 
 
-def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None):
+def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None,
+                grid=None):
     """Stage 2 + tridiagonal seam, method-dispatched (the MethodEig
     consumer).  Returns (w, Z2) with band = Z2 diag(w) Z2^H (Z2 None when
     jobz=False).
@@ -274,7 +268,7 @@ def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None):
             return w, Z2
         return jnp.linalg.eigvalsh(band), None
     d, e, Q2 = _hb2st(band, nb, want_q=jobz)
-    w, ztri = _tridiag_eig(d, e, jobz, opts)
+    w, ztri = _tridiag_eig(d, e, jobz, opts, grid)
     if not jobz:
         return w, None
     return w, Q2 @ ztri.astype(Q2.dtype)
@@ -366,10 +360,11 @@ def _heev_mesh(A, opts, jobz: bool):
                                         SUPERBLOCKS * la))
     st_packed = TileStorage(data, st_in.m, st_in.n, nb, nb, grid)
     band = _band_from_tiles(st_packed, n, nb)
-    # ONE stage-2 dispatch shared with the single-target path (stage 2 is
-    # single-node by design, as the reference's is); only the stage-1
-    # back-transform below is mesh-distributed
-    w, Z2 = _stage2_eig(band, nb, jobz, opts)
+    # ONE stage-2 dispatch shared with the single-target path; the DC
+    # route's merge gemms are row-distributed over this grid's mesh
+    # (drivers/stedc.py _merge_gemm), the rest of stage 2 is single-node
+    # by design, as the reference's is
+    w, Z2 = _stage2_eig(band, nb, jobz, opts, grid)
     if not jobz:
         return w, None
     Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))

@@ -84,19 +84,28 @@ def _blocks_of_row(L, j0, j1, nb):
     return row.reshape(j1 - j0, j + 1, nb).transpose(1, 0, 2)
 
 
-def _aasen_blocked(a, nb: int):
+def _aasen_blocked(a, nb: int, constrain=None):
     """Blocked Aasen on a dense Hermitian matrix (both triangles
     populated).  Returns (L, Tdiag, Tsub, piv) over the nb-padded space
-    (pad block = identity; pivots never select the zero pad rows)."""
+    (pad block = identity; pivots never select the zero pad rows).
+
+    ``constrain`` (mesh path): a function pinning an [n, *] array's rows
+    across the mesh — applied to the two big live arrays (ap, L) each
+    panel so GSPMD keeps them distributed and partitions the hot gemm
+    row-parallel; everything it feeds that is O(n nb) or smaller stays
+    replicated, the same big/small split as the reference's layout
+    (ref: hetrf.cc panel/update tasks)."""
+    pin = constrain or (lambda x: x)
     n0 = a.shape[0]
     dt = a.dtype
     Nt = max(1, -(-n0 // nb))
     n = Nt * nb
     ap = jnp.zeros((n, n), dt).at[:n0, :n0].set(a)
     pad = jnp.arange(n0, n)
-    ap = ap.at[pad, pad].set(1)
+    ap = pin(ap.at[pad, pad].set(1))
 
-    L = jnp.zeros((n, n), dt).at[jnp.arange(nb), jnp.arange(nb)].set(1)
+    L = pin(jnp.zeros((n, n), dt).at[jnp.arange(nb),
+                                     jnp.arange(nb)].set(1))
     Tdiag = jnp.zeros((Nt, nb, nb), dt)
     Tsub = jnp.zeros((max(Nt - 1, 1), nb, nb), dt)
     piv = jnp.arange(n)
@@ -148,10 +157,10 @@ def _aasen_blocked(a, nb: int):
                     jnp.triu(lu[:nb])[:min(wl, nb)]))
             # symmetric pivot application to the trailing rows/columns
             rp = jnp.arange(n).at[j1:j1 + wl].set(j1 + perm)
-            ap = ap[rp][:, rp]
+            ap = pin(ap[rp][:, rp])
             L = L[rp]
             piv = piv[rp]
-            L = L.at[j1:, j1:j1 + nb].set(Lp)
+            L = pin(L.at[j1:, j1:j1 + nb].set(Lp))
 
     return L[:n0, :n0], Tdiag, Tsub, piv[:n0]
 
@@ -169,16 +178,52 @@ def hetrf(A, opts: Options | None = None) -> HEFactors:
     slate_error(isinstance(A, HermitianMatrix) or not is_complex(A.dtype),
                 "hetrf: complex SymmetricMatrix unsupported (use "
                 "HermitianMatrix)")
+    from ..options import Target, resolve_target
     nb = A.nb
-    ad = A.to_dense()
+    if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
+        return _hetrf_mesh(A, nb)
     with jax.default_matmul_precision("highest"):
-        L, Tdiag, Tsub, piv = _aasen_blocked(ad, nb)
-        n0 = L.shape[0]
-        kd = min(nb, max(n0 - 1, 0))
-        gp = _packed_band_T(Tdiag, Tsub, nb, n0, kd)  # [2kd+1, n0]
-        work = jnp.zeros((3 * kd + 1, n0), gp.dtype).at[kd:].set(gp)
-        w = min(max(nb, 1), max(n0, 1))
-        Tlu, Tperms = gbtrf_banded(work, kd, kd, n0, w)
+        L, Tdiag, Tsub, piv = _aasen_blocked(A.to_dense(), nb)
+        return _finish_factors(L, Tdiag, Tsub, piv, nb)
+
+
+def _hetrf_mesh(A, nb: int) -> HEFactors:
+    """Mesh Aasen (ref: src/hetrf.cc:1-619 distributes the panel/update
+    gemms over ranks).
+
+    TPU-first layout choice: Aasen's live state is two [n, n] arrays (the
+    pivoted A and the growing L) updated one O(n nb) block column per
+    step — a ROW-SHARDED dense layout under GSPMD, not block-cyclic
+    tiles, maps this best: the hot gemm W = A[j0:, j] - L[j0:, :j0] H
+    partitions row-parallel with ZERO collectives (H is replicated and
+    O(n nb)), and the symmetric pivot gather is the only communicating
+    op.  A is expanded tile->dense with its rows immediately pinned
+    across all mesh devices — no replicated [n, n] ever materializes —
+    and every panel re-pins A and L (see _aasen_blocked's ``constrain``).
+    Panel-sized objects (H, T blocks, panel LU, T's band factors) stay
+    replicated: the same big/small split as the reference's layout."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..core.grid import AXIS_P, AXIS_Q
+    rowsh = NamedSharding(A.grid.mesh, P((AXIS_P, AXIS_Q), None))
+
+    def pin(x):
+        return jax.lax.with_sharding_constraint(x, rowsh)
+
+    with jax.default_matmul_precision("highest"):
+        ad = pin(A.to_dense())
+        L, Tdiag, Tsub, piv = _aasen_blocked(ad, nb, constrain=pin)
+        return _finish_factors(L, Tdiag, Tsub, piv, nb)
+
+
+def _finish_factors(L, Tdiag, Tsub, piv, nb: int) -> HEFactors:
+    """Band-LU T once (ref: hetrf.cc factors T with gbtrf inside the
+    factorization); callers hold matmul precision pinned."""
+    n0 = L.shape[0]
+    kd = min(nb, max(n0 - 1, 0))
+    gp = _packed_band_T(Tdiag, Tsub, nb, n0, kd)      # [2kd+1, n0]
+    work = jnp.zeros((3 * kd + 1, n0), gp.dtype).at[kd:].set(gp)
+    w = min(max(nb, 1), max(n0, 1))
+    Tlu, Tperms = gbtrf_banded(work, kd, kd, n0, w)
     return HEFactors(L, Tdiag, Tsub, piv, nb, Tlu, Tperms)
 
 

@@ -45,20 +45,39 @@ class LUFactors(NamedTuple):
         return TriangularMatrix._from_view(self.LU, Uplo.Upper)
 
 
+def _apply_row_perm(mat, perm, bound: int):
+    """Apply a row permutation that displaces at most ``bound`` rows by
+    touching ONLY those rows (gather + scatter of the bundle) — a full
+    ``mat[perm]`` gather reads and rewrites the entire trailing matrix
+    per panel.  Partial pivoting, threshold pivoting and the tournament
+    placement are all products of <= nb transpositions, so bound = 2 nb.
+    """
+    W = perm.shape[0]
+    if W == 0 or mat.shape[1] == 0:
+        return mat
+    k = min(W, bound)
+    moved = (perm != jnp.arange(W)).astype(jnp.int32)
+    _, idx = lax.top_k(moved, k)
+    return mat.at[idx].set(mat[perm[idx]], unique_indices=True)
+
+
 def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
                          mpt: int = 4, depth: int = 2):
     """Blocked right-looking LU, statically-shaped panels (unrolled).
 
     Panel factor delegates to XLA's native pivoted LU (the analog of the
-    reference's lapack panel kernel); trailing update is trsm + one MXU
-    gemm per panel (ref: getrf.cc:174-215 trailing task).  ``tau`` < 1
-    switches to threshold pivoting (Option.PivotThreshold); ``mpt``
+    reference's lapack panel kernel); the trailing row exchange touches
+    only the <= 2 nb displaced rows, and the U12 solve is one MXU gemm
+    against the inverted unit-L11 (internal/trsm.py tri_inv_lower) —
+    ref: getrf.cc:174-215 trailing task.  ``tau`` < 1 switches to
+    threshold pivoting (Option.PivotThreshold); ``mpt``
     (Option.MaxPanelThreads) splits the tournament panel into ~mpt
     independent row blocks (the analog of panel threads: more threads =
     more, smaller blocks) and ``depth`` (Option.Depth) is the
     reduction-tree fan-in."""
     from ..internal.getrf import (panel_lu, panel_lu_nopiv,
                                   panel_lu_threshold, panel_lu_tournament)
+    from ..internal.trsm import tri_inv_lower
     m, n = a.shape
     kmax = min(m, n)
     perm_g = jnp.arange(m)
@@ -78,14 +97,12 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
             lu, perm = panel_lu(pan)
         a = a.at[k0:, k0:k1].set(lu)
         if method != "nopiv":
-            a = a.at[k0:, :k0].set(a[k0:, :k0][perm])
-            a = a.at[k0:, k1:].set(a[k0:, k1:][perm])
+            a = a.at[k0:, :k0].set(_apply_row_perm(a[k0:, :k0], perm, 2 * w))
+            a = a.at[k0:, k1:].set(_apply_row_perm(a[k0:, k1:], perm, 2 * w))
             perm_g = perm_g.at[k0:].set(perm_g[k0:][perm])
         if k1 < n:
             l11 = lu[:w, :w]
-            u12 = lax.linalg.triangular_solve(
-                l11, a[k0:k1, k1:], left_side=True, lower=True,
-                unit_diagonal=True)
+            u12 = tri_inv_lower(l11, unit_diag=True) @ a[k0:k1, k1:]
             a = a.at[k0:k1, k1:].set(u12)
             if k1 < m:
                 l21 = lu[w:, :w]

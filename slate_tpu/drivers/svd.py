@@ -96,16 +96,10 @@ def _ge2tb_scan(a, nb: int):
 
 
 def _band_upper_from_stacks(Ds, Ss, n: int, nb: int):
-    """Dense upper band from the ge2tb scan's band tiles: two vectorized
-    tile scatters + one untile (single-target twin of
-    _band_upper_from_tiles)."""
-    from ..core import layout
-    K = Ds.shape[0]
-    g = jnp.arange(K)
-    tiles = jnp.zeros((K, K, nb, nb), Ds.dtype).at[g, g].set(jnp.triu(Ds))
-    if K > 1:
-        tiles = tiles.at[g[:-1], g[:-1] + 1].set(jnp.tril(Ss[:-1]))
-    bd = layout.untile_dense(tiles, K * nb, K * nb)
+    """Dense upper band from the ge2tb scan's band tiles (single-target
+    twin of _band_upper_from_tiles)."""
+    from ..core.layout import assemble_band
+    bd = assemble_band(jnp.triu(Ds), jnp.tril(Ss), lower=False)
     return _band_upper_of(bd[:n, :n], n, nb)
 
 
@@ -322,20 +316,13 @@ def _band_upper_from_tiles(st, n: int, nb: int):
     diagonal tiles + tril of superdiagonal tiles, gathered straight from
     the cyclic data (the analog of TriangularBandMatrix::ge2tbGather,
     ref: svd.cc:153-160 — only the O(n nb) band tiles leave the mesh)."""
-    from ..core import layout
+    from ..core.layout import assemble_band
     from .heev import _band_diag_tiles
     Ntn = -(-n // nb)
-    dd = _band_diag_tiles(st, 0)[:Ntn]
-    npad = Ntn * nb
-    g = jnp.arange(Ntn)
-    # two vectorized tile scatters + one untile (not an O(Nt) unrolled
-    # chain of dense updates — same fix as heev._band_from_tiles)
-    tiles = jnp.zeros((Ntn, Ntn, nb, nb), st.dtype).at[g, g].set(
-        jnp.triu(dd))
-    if Ntn > 1:
-        ss = _band_diag_tiles(st, -1)[:Ntn - 1]   # tiles (g, g+1)
-        tiles = tiles.at[g[:-1], g[:-1] + 1].set(jnp.tril(ss))
-    bd = layout.untile_dense(tiles, npad, npad)
+    dd = jnp.triu(_band_diag_tiles(st, 0)[:Ntn])
+    ss = (jnp.tril(_band_diag_tiles(st, -1)[:Ntn - 1]) if Ntn > 1
+          else jnp.zeros((0, nb, nb), st.dtype))  # tiles (g, g+1)
+    bd = assemble_band(dd, ss, lower=False)
     return _band_upper_of(bd[:n, :n], n, nb)
 
 

@@ -22,8 +22,11 @@ Analog of the reference's threaded+MPI LU panels:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .trsm import tri_inv_lower, tri_inv_upper
 
 
 def panel_lu(panel):
@@ -39,21 +42,22 @@ def panel_lu(panel):
 def panel_lu_nopiv(panel):
     """No-pivot LU of a panel [W, nb] (ref: Tile_getrf_nopiv.hh).
 
-    Square top block factored unpivoted; rows below solved against U.
+    Square top block factored unpivoted; rows below are one MXU gemm
+    against the inverted U (tri_inv_upper) instead of a per-column
+    substitution loop.
     """
     nb = panel.shape[1]
     top = panel[:nb]
     lu_top = _lu_nopiv_square(top)
     u = jnp.triu(lu_top)
-    below = lax.linalg.triangular_solve(
-        u, panel[nb:], left_side=False, lower=False)
+    below = panel[nb:] @ tri_inv_upper(u)
     lu = jnp.concatenate([lu_top, below], axis=0)
     perm = jnp.arange(panel.shape[0])
     return lu, perm
 
 
-def _lu_nopiv_square(a):
-    """Unpivoted LU of a square block via fori_loop Gaussian elimination."""
+def _lu_nopiv_base(a):
+    """Unpivoted LU of a small square block via fori_loop elimination."""
     n = a.shape[0]
 
     def body(j, a):
@@ -66,6 +70,26 @@ def _lu_nopiv_square(a):
         return a
 
     return lax.fori_loop(0, n, body, a)
+
+
+def _lu_nopiv_square(a, base: int = 64):
+    """Unpivoted LU of a square block, recursively blocked: the rank-1
+    elimination loop only ever runs on <= base-wide blocks; everything
+    between is tri_inv-powered MXU gemms (same discipline as the blocked
+    Householder panel, internal/qr.py)."""
+    n = a.shape[0]
+    if n <= base:
+        return _lu_nopiv_base(a)
+    h = n // 2
+    a11 = _lu_nopiv_square(a[:h, :h], base)
+    l11 = jnp.tril(a11, -1) + jnp.eye(h, dtype=a.dtype)
+    u11 = jnp.triu(a11)
+    u12 = tri_inv_lower(l11, unit_diag=True) @ a[:h, h:]
+    l21 = a[h:, :h] @ tri_inv_upper(u11)
+    a22 = _lu_nopiv_square(a[h:, h:] - l21 @ u12, base)
+    top = jnp.concatenate([a11, u12], axis=1)
+    bot = jnp.concatenate([l21, a22], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
 
 
 def panel_lu_threshold(panel, tau):
@@ -115,63 +139,78 @@ def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     """CALU tournament pivot selection + clean factorization
     (ref: internal_getrf_tntpiv.cc, Tile_getrf_tntpiv.hh).
 
-    Round 1: factor each block of ``block_rows`` rows independently and keep
-    its nb pivot rows.  Reduction rounds: merge ``arity`` candidate sets at
-    a time (Option.Depth — the reduction-tree fan-in) with another LU until
-    one set remains.  Finally permute the chosen rows to the top and factor
-    the whole panel without further pivoting across blocks.
+    Round 1: factor every block of ``block_rows`` rows in ONE batched
+    (vmapped) pivoted LU and keep each block's nb pivot rows.  Reduction
+    rounds: merge ``arity`` candidate sets at a time (Option.Depth — the
+    fan-in), again one batched LU per LEVEL — the tree is latency-bound,
+    and XLA's batched LU amortizes its per-column While latency across
+    the whole batch (measured 5.4x, docs/ceiling.jsonl xla_lu batch32).
+    Finally the chosen rows move to the top via a VECTORIZED permutation
+    that displaces at most 2 nb rows (the bound the distributed bundle
+    exchange relies on), and the permuted panel is factored with no
+    further pivoting across blocks — CALU's defining step.
     Returns (lu, perm) like :func:`panel_lu`.
     """
     arity = max(2, int(arity))
     W, nb = panel.shape
-    rows = jnp.arange(W)
+    iota = jnp.arange(W)
+    if W <= nb:
+        lu, _, perm = lax.linalg.lu(panel)
+        return lu, perm
+    block_rows = max(block_rows, nb)
+    nch = -(-W // block_rows)
+    Wp = nch * block_rows
+    pp = jnp.pad(panel, ((0, Wp - W), (0, 0)))
+    # pad rows carry sentinel index W; all-zero, they lose every pivot
+    # contest against any nonzero row
+    gidx = jnp.concatenate([iota, jnp.full((Wp - W,), W, iota.dtype)])
+    cand = pp.reshape(nch, block_rows, nb)
+    cidx = gidx.reshape(nch, block_rows)
 
-    def best_rows(block, idx):
-        """nb pivot-candidate rows of a block and their global indices."""
-        _, _, p = lax.linalg.lu(block)
-        return block[p[:nb]], idx[p[:nb]]
+    def keep_best(blocks, idx):
+        _, _, pb = jax.vmap(lax.linalg.lu)(blocks)
+        take = pb[:, :nb]
+        return (jnp.take_along_axis(blocks, take[:, :, None], axis=1),
+                jnp.take_along_axis(idx, take, axis=1))
 
-    # round 1 over static row blocks
-    cands, cidx = [], []
-    for s in range(0, W, block_rows):
-        e = min(s + block_rows, W)
-        blk = panel[s:e]
-        if e - s < nb:  # tiny tail: keep all its rows as candidates
-            cands.append(blk)
-            cidx.append(rows[s:e])
-        else:
-            b, i = best_rows(blk, rows[s:e])
-            cands.append(b)
-            cidx.append(i)
-    # reduction tree, fan-in = arity
-    while len(cands) > 1:
-        nxt_c, nxt_i = [], []
-        for t in range(0, len(cands), arity):
-            grp_c = cands[t: t + arity]
-            grp_i = cidx[t: t + arity]
-            if len(grp_c) == 1:
-                nxt_c.append(grp_c[0])
-                nxt_i.append(grp_i[0])
-            else:
-                merged = jnp.concatenate(grp_c, axis=0)
-                midx = jnp.concatenate(grp_i)
-                b, i = best_rows(merged, midx)
-                nxt_c.append(b)
-                nxt_i.append(i)
-        cands, cidx = nxt_c, nxt_i
-    chosen = cidx[0][:nb]                     # global rows chosen as pivots
+    if block_rows > nb:
+        cand, cidx = keep_best(cand, cidx)
+    while cand.shape[0] > 1:
+        g = cand.shape[0]
+        gp = -(-g // arity) * arity
+        if gp > g:
+            cand = jnp.concatenate(
+                [cand, jnp.zeros((gp - g,) + cand.shape[1:], cand.dtype)])
+            cidx = jnp.concatenate(
+                [cidx, jnp.full((gp - g, cidx.shape[1]), W, cidx.dtype)])
+        rows_per = cand.shape[1]
+        cand = cand.reshape(gp // arity, arity * rows_per, nb)
+        cidx = cidx.reshape(gp // arity, arity * rows_per)
+        cand, cidx = keep_best(cand, cidx)
+    chosen = cidx[0, :nb]
+    # sentinel guard (only reachable for a singular panel): fill sentinel
+    # slots with the smallest NOT-chosen rows so `chosen` stays a set of
+    # nb DISTINCT in-range rows (a naive slot-index fallback can collide
+    # with a genuinely chosen row and silently drop a matrix row)
+    valid = chosen < W
+    in_ch0 = jnp.zeros((W,), jnp.bool_).at[
+        jnp.where(valid, chosen, 0)].set(valid)
+    free = jnp.sort(jnp.where(in_ch0, W + iota, iota))
+    kfree = jnp.cumsum(~valid) - 1
+    chosen = jnp.where(valid, chosen,
+                       free[jnp.clip(kfree, 0, W - 1)].astype(chosen.dtype))
 
-    # Bring chosen[j] to row j via nb TRANSPOSITIONS (so the composed perm
-    # displaces <= 2 nb rows — the bound the distributed row exchange relies
-    # on, same as partial pivoting's ipiv products), then factor the
-    # permuted panel with NO further pivoting: that is CALU's defining step
-    # (ref: getrf_tntpiv applies the tournament pivots then an unpivoted
-    # panel factorization).
-    def bring(j, arr):
-        pos = jnp.argmax(arr == chosen[j])
-        vj, vp = arr[j], arr[pos]
-        return arr.at[j].set(vp).at[pos].set(vj)
-
-    perm = lax.fori_loop(0, nb, bring, jnp.arange(W))
+    # Vectorized pivot placement: perm[j] = chosen[j] for j < nb, and the
+    # displaced top rows fill the holes the chosen rows left (both in
+    # ascending order) — a permutation displacing <= 2 nb rows, with no
+    # nb-step transposition loop.
+    in_ch = jnp.zeros((W,), jnp.bool_).at[chosen].set(True)
+    s1 = (~in_ch) & (iota < nb)              # top rows pushed out
+    s2 = in_ch & (iota >= nb)                # holes left below
+    idx1 = jnp.sort(jnp.where(s1, iota, W + iota))[:nb]
+    r2 = jnp.cumsum(s2.astype(jnp.int32)) - 1
+    fill = idx1[jnp.clip(r2, 0, nb - 1)]
+    perm = iota.at[:nb].set(chosen)
+    perm = jnp.where(s2, jnp.where(fill < W, fill, iota), perm)
     lu, _ = panel_lu_nopiv(panel[perm])
     return lu, perm

@@ -1,0 +1,99 @@
+"""Pallas TPU kernel: Cholesky of one diagonal tile, VMEM-resident.
+
+The reference factors diagonal tiles with vendor LAPACK potrf
+(ref: src/internal/internal_potrf.cc:132).  XLA's TPU Cholesky runs a
+per-column While loop — measured 2.07 ms for a 512x512 f32 tile
+(docs/ceiling.jsonl xla_cholesky_512), which times 32 sequential panel
+steps is the single largest cost in a 16k potrf.  This kernel keeps the
+whole tile in VMEM for the entire factorization.
+
+Formulation: the UPPER factor U with A = U^T U, processed in ``bw``-ROW
+panels — Mosaic only allows dynamic slicing in 128-multiples along the
+lane (last) dimension, but sublane (row) slices may move in multiples of
+8, so an 8-row panel keeps every sequential step's operand at one vreg
+row [8, n] instead of a [n, 128] half-tile.  The diagonal block is
+mirrored into a [bw, bw] array via a one-hot MXU contraction (no lane
+slicing), scalars come from mask+reduce, and the inter-panel trailing
+update is a single MXU dot P^T P.  The caller transposes U once to
+return the conventional lower L.
+
+Real f32 only; complex/f64 tiles use the XLA fallback (potrf_tile).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_HI = lax.Precision.HIGHEST
+
+
+def _chol_kernel(a_ref, o_ref, *, bw: int):
+    n = a_ref.shape[0]
+    dt = a_ref.dtype
+    rows = lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    pr = lax.broadcasted_iota(jnp.int32, (bw, n), 0)
+    cn = lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    br = lax.broadcasted_iota(jnp.int32, (bw, bw), 0)
+    bc = lax.broadcasted_iota(jnp.int32, (bw, bw), 1)
+    bc1 = lax.broadcasted_iota(jnp.int32, (1, bw), 1)
+    o_ref[:] = a_ref[:]
+
+    def block_step(b, _):
+        j0 = b * bw
+        P = o_ref[pl.ds(j0, bw), :]                  # [bw, n] row panel
+        selT = (lax.broadcasted_iota(jnp.int32, (n, bw), 0)
+                == j0 + lax.broadcasted_iota(jnp.int32, (n, bw), 1))
+        D = jnp.dot(P, selT.astype(dt), preferred_element_type=dt,
+                    precision=_HI)                   # P[:, j0:j0+bw]
+
+        def col_step(i, PD):
+            P, D = PD
+            j = j0 + i
+            piv = jnp.sqrt(jnp.sum(jnp.where((br == i) & (bc == i), D, 0)))
+            inv = 1.0 / piv
+            drow = jnp.sum(jnp.where(br == i, D, 0), axis=0,
+                           keepdims=True)            # [1, bw] row i of D
+            # u_j = row j of U: row i of P scaled, left-of-diag zeroed
+            prow = jnp.sum(jnp.where(pr == i, P, 0), axis=0, keepdims=True)
+            urow = jnp.where(cn < j, 0.0, prow * inv)
+            # block-row couplings: u_j restricted to this panel's columns
+            ublk = jnp.where(bc1 == i, piv, drow * inv)
+            ublk = jnp.where(bc1 < i, 0.0, ublk)     # [1, bw]
+            coefT = ublk.reshape(bw, 1)
+            P = jnp.where(pr == i, urow,
+                          jnp.where(pr > i, P - coefT * urow, P))
+            D = jnp.where(br == i, ublk,
+                          jnp.where(br > i, D - coefT * ublk, D))
+            return P, D
+
+        P, _ = lax.fori_loop(0, bw, col_step, (P, D))
+        o_ref[pl.ds(j0, bw), :] = P
+        # trailing rows: A -= P^T P (contract the panel-row axis)
+        upd = lax.dot_general(P, P, (((0,), (0,)), ((), ())),
+                              preferred_element_type=dt, precision=_HI)
+        av = o_ref[:]
+        o_ref[:] = jnp.where(rows >= j0 + bw, av - upd, av)
+        return 0
+
+    lax.fori_loop(0, n // bw, block_step, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "interpret"))
+def chol_tile_pallas(a, bw: int = 8, interpret: bool = False):
+    """Lower Cholesky factor of an SPD tile [n, n], n % bw == 0,
+    bw % 8 == 0."""
+    n = a.shape[0]
+    u = pl.pallas_call(
+        functools.partial(_chol_kernel, bw=bw),
+        out_shape=jax.ShapeDtypeStruct((n, n), a.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(a)
+    return u.T

@@ -1,0 +1,136 @@
+"""Pallas TPU kernel: partial-pivot row selection for one LU panel chunk.
+
+The CALU tournament (internal/getrf.py panel_lu_tournament) needs each
+row block's nb partial-pivot rows (ref: internal_getrf_tntpiv.cc round-1
+LUs).  XLA's pivoted LU streams the whole [W, nb] chunk from HBM once
+per column — measured 31 us/column at [4096, 512] (docs/ceiling.jsonl
+xla_lu_4096x512), i.e. 15.8 ms for work whose flops cost ~0.1 ms.  This
+kernel keeps the chunk in VMEM TRANSPOSED ([nb, W]: columns of A on
+sublanes, rows of A on lanes) so each elimination step touches one 8-row
+slab; pivoted rows are MASKED out of the search instead of physically
+swapped, and each slab's trailing update is two MXU dots against the
+recorded multiplier/selection slabs.
+
+Output: the pivot ROW indices [1, nb] int32, in elimination order —
+exactly lax.linalg.lu's perm[:nb] for the same chunk (up to argmax tie
+order).  Round 1 of the tournament needs nothing else: the candidate
+values it forwards are the ORIGINAL rows, gathered by these indices.
+
+Real f32 only; the XLA LU remains the fallback (and the test oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_HI = lax.Precision.HIGHEST
+
+
+def _lu_select_kernel(at_ref, mask_ref, piv_ref, ws_ref, lbuf_ref,
+                      sbuf_ref, *, bw: int):
+    nb, W = at_ref.shape
+    dt = at_ref.dtype
+    ws_ref[:] = at_ref[:]
+    lane = lax.broadcasted_iota(jnp.int32, (1, W), 1)
+    lane_nb = lax.broadcasted_iota(jnp.int32, (1, nb), 1)
+    sl = lax.broadcasted_iota(jnp.int32, (bw, W), 0)
+    rows_nb = lax.broadcasted_iota(jnp.int32, (nb, W), 0)
+    piv_ref[:] = jnp.zeros((1, nb), jnp.int32)
+    # allowed lanes: live rows only (caller masks ragged padding).
+    # Kept as an f32 0/1 mask — Mosaic cannot carry bool vectors through
+    # its loop lowering ("failed to legalize scf.for").
+    allowed0 = mask_ref[:]
+
+    def slab_step(b, allowed):
+        j0 = b * bw
+        slab = ws_ref[pl.ds(j0, bw), :]              # [bw, W]
+        lbuf = jnp.zeros((bw, W), dt)                # multiplier rows
+        sbuf = jnp.zeros((bw, W), dt)                # one-hot pivot rows
+
+        def col_step(i, carry):
+            slab, lbuf, sbuf, allowed = carry
+            mrow = jnp.sum(jnp.where(sl == i, slab, 0), axis=0,
+                           keepdims=True)            # [1, W]
+            cand = jnp.where(allowed > 0, jnp.abs(mrow), -1.0)
+            p = jnp.argmax(cand)                     # scalar lane index
+            onehot = lane == p
+            pivval = jnp.sum(jnp.where(onehot, mrow, 0))
+            safe = jnp.where(pivval == 0, 1.0, pivval)
+            lmask = (allowed > 0) & ~onehot
+            l = jnp.where(lmask & (pivval != 0), mrow / safe, 0.0)
+            # eliminate within the slab: rows r > i lose their p-lane
+            # coupling times l
+            colp = jnp.sum(jnp.where(onehot, slab, 0), axis=1,
+                           keepdims=True)            # [bw, 1]
+            slab = jnp.where(sl > i, slab - colp * l, slab)
+            lbuf = jnp.where(sl == i, l, lbuf)
+            sbuf = jnp.where(sl == i, jnp.where(onehot, 1.0, 0.0), sbuf)
+            piv_ref[:] = jnp.where(lane_nb == j0 + i,
+                                   p.astype(jnp.int32), piv_ref[:])
+            return slab, lbuf, sbuf, jnp.where(onehot, 0.0, allowed)
+
+        slab, lbuf, sbuf, allowed = lax.fori_loop(
+            0, bw, col_step, (slab, lbuf, sbuf, allowed))
+        ws_ref[pl.ds(j0, bw), :] = slab
+        lbuf_ref[:] = lbuf
+        sbuf_ref[:] = sbuf
+        # Deferred trailing update.  A trailing row's pivot-lane values
+        # EVOLVE during the slab (lane p_k is updated by steps i < k), so
+        # the one-shot coefficients are u = (I + N)^-1 c0 with
+        # N[k, i] = l_i[p_k] strictly lower (nilpotent), c0 the pivot-lane
+        # values at slab start — then ws[r, :] -= sum_i u_i l_i.
+        eye = (lax.broadcasted_iota(jnp.int32, (bw, bw), 0)
+               == lax.broadcasted_iota(jnp.int32, (bw, bw), 1)).astype(dt)
+        B = lax.dot_general(lbuf, sbuf, (((1,), (1,)), ((), ())),
+                            preferred_element_type=dt, precision=_HI)
+        N = jnp.where(lax.broadcasted_iota(jnp.int32, (bw, bw), 0)
+                      > lax.broadcasted_iota(jnp.int32, (bw, bw), 1),
+                      B.T, 0.0)
+        # (I + N)^-1 = (I - N)(I + N^2)(I + N^4) ... (N nilpotent)
+        inv = eye - N
+        N2 = jnp.dot(N, N, preferred_element_type=dt, precision=_HI)
+        steps = 1
+        while 2 * steps < bw:
+            inv = jnp.dot(inv, eye + N2, preferred_element_type=dt,
+                          precision=_HI)
+            N2 = jnp.dot(N2, N2, preferred_element_type=dt, precision=_HI)
+            steps *= 2
+        wsv = ws_ref[:]
+        c0 = lax.dot_general(wsv, sbuf_ref[:], (((1,), (1,)), ((), ())),
+                             preferred_element_type=dt, precision=_HI)
+        u = jnp.dot(c0, inv.T, preferred_element_type=dt, precision=_HI)
+        upd = jnp.dot(u, lbuf_ref[:], preferred_element_type=dt,
+                      precision=_HI)                 # [nb, W]
+        ws_ref[:] = jnp.where(rows_nb > j0 + bw - 1, wsv - upd, wsv)
+        return allowed
+
+    lax.fori_loop(0, nb // bw, slab_step, allowed0)
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "interpret"))
+def lu_select_pallas(chunk, nrows: jax.Array | None = None, bw: int = 8,
+                     interpret: bool = False):
+    """Pivot row indices [nb] of a chunk [W, nb] (W % 128 == 0 after the
+    caller's padding; ``nrows`` masks the live rows, default all)."""
+    W, nb = chunk.shape
+    at = chunk.T
+    live = (jnp.arange(W, dtype=jnp.int32)[None, :]
+            < (W if nrows is None else nrows)).astype(jnp.float32)
+    piv = pl.pallas_call(
+        functools.partial(_lu_select_kernel, bw=bw),
+        out_shape=jax.ShapeDtypeStruct((1, nb), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((nb, W), chunk.dtype),
+                        pltpu.VMEM((bw, W), chunk.dtype),
+                        pltpu.VMEM((bw, W), chunk.dtype)],
+        interpret=interpret,
+    )(at, live)
+    return piv[0]

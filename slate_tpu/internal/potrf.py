@@ -1,16 +1,47 @@
 """internal::potrf — diagonal-tile Cholesky factor.
 
 Analog of the reference's internal_potrf.cc:132 (lapack::potrf on the
-diagonal tile, host or device).  The reference delegates the tile factor to
-vendor LAPACK; we delegate to XLA's native blocked Cholesky, which on TPU
-lowers to MXU-shaped HLO — same division of labour, different vendor.
+diagonal tile, host or device).  The reference delegates the tile factor
+to vendor LAPACK; on TPU the vendor seam (XLA's Cholesky) runs a
+per-column While loop — 2.07 ms per 512 f32 tile (docs/ceiling.jsonl).
+A VMEM-resident Pallas kernel (internal/pallas_chol.py) exists but
+measures the same per-column latency on this chip generation
+(docs/PERF.md), so XLA remains the default; set SLATE_PALLAS=1 to route
+real-TPU f32 tiles through the Pallas kernel instead.
 """
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
+
+_PALLAS_TPU = None
+
+
+def _pallas_ok() -> bool:
+    global _PALLAS_TPU
+    if _PALLAS_TPU is None:
+        # opt-in: at bench shapes the kernel currently only ties XLA's
+        # per-column cost (4.4 us/col vs 4.0 — docs/PERF.md), so the
+        # proven XLA path stays the default
+        if os.environ.get("SLATE_PALLAS") != "1":
+            _PALLAS_TPU = False
+        else:
+            try:
+                d = jax.devices()[0]
+                _PALLAS_TPU = "tpu" in (d.platform + d.device_kind).lower()
+            except Exception:  # noqa: BLE001 — no backend: stay on XLA
+                _PALLAS_TPU = False
+    return _PALLAS_TPU
 
 
 def potrf_tile(a):
     """Factor one Hermitian positive-definite tile: returns lower L."""
+    n = a.shape[-1]
+    if (a.ndim == 2 and a.dtype == jnp.float32 and n % 128 == 0
+            and 128 <= n <= 1024 and _pallas_ok()):
+        from .pallas_chol import chol_tile_pallas
+        return chol_tile_pallas(a)
     return jnp.linalg.cholesky(a)

@@ -17,6 +17,7 @@ applies H_j^H (= H_j for real) to the trailing columns.  Q = I - V T V^H.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -85,28 +86,91 @@ def householder_panel(a):
     return packed, taus
 
 
+def panel_qr_cholqr(a):
+    """CholQR2 + Householder reconstruction of a tall panel [mm, w]:
+    every op an MXU gemm / small batched inverse — no per-column loop.
+
+    CholQR2: G = P^H P, R1 = chol(G)^H, Q = P R1^-1, repeated once (the
+    second pass restores orthogonality to eps * kappa(Q1)^2 ~ eps).
+    Reconstruction (the LAPACK dorhr_col idea): with S = diag(s),
+    s_j = -phase(Q_jj), the matrix  M = E - Q S  (E = [I_w; 0]) has an
+    unpivoted LU  M = V W  with V exactly the unit-lower Householder
+    aggregate and W = T V1^H — so V comes from one small LU and
+    T = W V1^-H.  Then A = (I - V T V^H) E (S R): the packed panel holds
+    S R above the diagonal and V below, byte-compatible with
+    householder_panel's output.
+
+    Returns (packed, T, ok): ok is False when the gram Cholesky broke
+    down (kappa(P)^2 beyond the dtype — callers fall back to the scan
+    panel), detected as any non-finite output."""
+    from .getrf import _lu_nopiv_square
+    from .trsm import tri_inv_lower, tri_inv_upper
+    mm, w = a.shape
+    eye = jnp.eye(w, dtype=a.dtype)
+    iw = jnp.arange(w)
+    # only the GRAMS need 3-pass ("high", ~f32-mantissa) matmuls: the
+    # cancellation Q^H Q - I is what the kappa^2 term amplifies; the tall
+    # Q updates ride the default single-pass rate (their elementwise
+    # error is the framework's f32-on-TPU story, and pass 2's
+    # high-precision gram sees — and corrects — pass 1's products)
+    hi = dict(precision=lax.Precision.HIGH)
+    G = jnp.matmul(jnp.conj(a).T, a, **hi)
+    L1 = jnp.linalg.cholesky(G)
+    Q = a @ jnp.conj(tri_inv_lower(L1)).T
+    G2 = jnp.matmul(jnp.conj(Q).T, Q, **hi)
+    L2 = jnp.linalg.cholesky(G2)
+    Q = Q @ jnp.conj(tri_inv_lower(L2)).T
+    R = jnp.matmul(jnp.conj(L2).T, jnp.conj(L1).T, **hi)
+    s = -phase_of(jnp.diagonal(Q[:w]))
+    M = (-Q * s[None, :]).at[iw, iw].add(1)          # E - Q S
+    lu_top = _lu_nopiv_square(M[:w])
+    V1 = jnp.tril(lu_top, -1) + eye
+    W = jnp.triu(lu_top)
+    V2 = M[w:] @ tri_inv_upper(W)
+    T = jnp.matmul(W, jnp.conj(tri_inv_lower(V1, unit_diag=True)).T, **hi)
+    # A = (I - V T V^H) E (S^-1 R); S is unitary diagonal so S^-1 = conj(S)
+    Rs = jnp.triu(R * jnp.conj(s)[:, None])
+    packed = jnp.concatenate([Rs + jnp.tril(V1, -1), V2], axis=0)
+    ok = jnp.all(jnp.isfinite(packed)) & jnp.all(jnp.isfinite(T))
+    return packed, T, ok
+
+
 def householder_panel_blocked(a, base_w: int = 32):
-    """Recursively blocked Householder QR of a panel [mm, w]: split the
-    columns, factor left, larfb the right half, factor right, and merge
-    the T triangles — T = [[T1, -T1 (V1^H V2) T2], [0, T2]] (the compact
-    WY merge, ref: lapack dlarft recursion / internal_geqrf's ib blocking).
+    """Blocked Householder QR of a panel [mm, w].
 
-    Identical math to :func:`householder_panel`, but the sequential
-    rank-1 loop only ever runs on ``base_w``-wide base panels, so the
-    per-step memory traffic drops from O(mm * w) to O(mm * base_w) — the
-    difference between a latency-bound and a bandwidth-reasonable panel
-    for the tall-skinny shapes (131072 x 256 and the like).
+    Tall panels (mm >= 2 w) first try the one-shot CholQR2 +
+    reconstruction route (:func:`panel_qr_cholqr`) — ~8 bandwidth passes
+    over the panel, all MXU — and fall back under lax.cond to the
+    recursive scan path only when the gram Cholesky breaks down
+    (kappa(P) beyond ~1/sqrt(eps), or structurally rank-deficient
+    panels such as the zero-padded tails of the scan-form reductions).
 
-    Returns (packed, T) — note: the T triangle directly, unlike
+    The fallback splits the columns, factors left, larfbs the right
+    half, factors right, and merges the T triangles —
+    T = [[T1, -T1 (V1^H V2) T2], [0, T2]] (the compact WY merge,
+    ref: lapack dlarft recursion / internal_geqrf's ib blocking) — with
+    the sequential rank-1 loop confined to ``base_w``-wide base panels.
+
+    Returns (packed, T) — the T triangle directly, unlike
     householder_panel's taus."""
+    mm, w = a.shape
+    if mm >= 2 * w and w >= 8:
+        pc, Tc, ok = panel_qr_cholqr(a)
+        return lax.cond(ok, lambda: (pc, Tc),
+                        lambda: _householder_blocked_rec(a, base_w))
+    return _householder_blocked_rec(a, base_w)
+
+
+def _householder_blocked_rec(a, base_w: int = 32):
+    """The scan-based recursive panel (see householder_panel_blocked)."""
     mm, w = a.shape
     if w <= base_w or mm < w:
         packed, taus = householder_panel(a)
         return packed, build_t(packed, taus)
     h = w // 2
-    p1, T1 = householder_panel_blocked(a[:, :h], base_w)
+    p1, T1 = _householder_blocked_rec(a[:, :h], base_w)
     right = apply_q_left(p1, T1, a[:, h:], conj_trans=True)
-    p2, T2 = householder_panel_blocked(right[h:], base_w)
+    p2, T2 = _householder_blocked_rec(right[h:], base_w)
     packed = jnp.concatenate(
         [p1, jnp.concatenate([right[:h], p2], axis=0)], axis=1)
     # V2's top h rows are structurally zero: restrict the gram product to

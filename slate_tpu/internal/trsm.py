@@ -31,3 +31,137 @@ def trsm_tile_batch(tri, b_batch, *, left: bool, lower: bool,
     low = lower if op_tri is Op.NoTrans else not lower
     return jax.vmap(lambda b: lax.linalg.triangular_solve(
         t, b, left_side=left, lower=low, unit_diagonal=unit_diag))(b_batch)
+
+
+def tri_inv_lower(L, unit_diag: bool = False, base: int = 32):
+    """Explicit inverse of a lower-triangular [n, n] block in LOG depth.
+
+    The reference's trsm panels run forward substitution (one column of
+    latency per step); on TPU a [15872, 512] triangular_solve measured
+    675 GFLOP/s (docs/ceiling.jsonl) because the per-column While loop
+    cannot feed the MXU.  The MAGMA-style alternative is to invert the
+    nb x nb diagonal block once and turn every panel solve into one MXU
+    gemm.  This inversion is itself log-depth and fully batched:
+
+      inv([[A, 0], [C, B]]) = [[inv(A), 0], [-inv(B) C inv(A), inv(B)]]
+
+    All ``base``-sized diagonal blocks are inverted in ONE batched
+    triangular_solve, then each doubling level merges all sibling pairs
+    with two batched matmuls — ~log2(n/base) * 3 device ops total, vs n
+    sequential column steps.  Pads to a power-of-two multiple of ``base``
+    with an identity diagonal (exact: the inverse of blockdiag(L, I) is
+    blockdiag(inv(L), I))."""
+    n = L.shape[0]
+    dt = L.dtype
+    if n <= base:
+        return lax.linalg.triangular_solve(
+            L, jnp.eye(n, dtype=dt), left_side=True, lower=True,
+            unit_diagonal=unit_diag)
+    n2 = base
+    while n2 < n:
+        n2 *= 2
+    if n2 > n:
+        r = jnp.arange(n, n2)
+        Lp = jnp.zeros((n2, n2), dt).at[:n, :n].set(L).at[r, r].set(1)
+    else:
+        Lp = L
+    m = n2 // base
+    i = jnp.arange(m)
+    d = Lp.reshape(m, base, m, base)[i, :, i, :]       # [m, base, base]
+    eye = jnp.eye(base, dtype=dt)
+    X = jax.vmap(lambda t: lax.linalg.triangular_solve(
+        t, eye, left_side=True, lower=True,
+        unit_diagonal=unit_diag))(d)
+    s = base
+    while s < n2:
+        m2 = X.shape[0] // 2
+        A, B = X[0::2], X[1::2]
+        Ls = Lp.reshape(n2 // s, s, n2 // s, s)
+        j = jnp.arange(m2)
+        C = Ls[2 * j + 1, :, 2 * j, :]                 # [m2, s, s]
+        off = -jnp.einsum("bij,bjk,bkl->bil", B, C, A)
+        top = jnp.concatenate([A, jnp.zeros_like(A)], axis=2)
+        bot = jnp.concatenate([off, B], axis=2)
+        X = jnp.concatenate([top, bot], axis=1)
+        s *= 2
+    return X[0][:n, :n]
+
+
+def tri_inv_upper(U, unit_diag: bool = False, base: int = 32):
+    """inv(U) for upper-triangular U via the lower-triangular engine:
+    inv(U) = inv(U^T)^T."""
+    return tri_inv_lower(U.T, unit_diag=unit_diag, base=base).T
+
+
+def _diag_tiles(ad, K: int, nb: int):
+    """[K, nb, nb] diagonal blocks of a [K nb, K nb] dense matrix."""
+    i = jnp.arange(K)
+    return ad.reshape(K, nb, K, nb)[i, :, i, :]
+
+
+def trsm_left_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
+                      unit: bool, nb: int):
+    """Solve op(A) X = B, A triangular [n, n] with n a multiple of nb, by
+    block substitution with ALL diagonal blocks inverted in one batched
+    log-depth pass (tri_inv_lower) — each step is then two MXU gemms.
+
+    XLA's monolithic triangular_solve runs a per-column While loop
+    (measured 4.1 TFLOP/s on [16384, 256], docs/ceiling.jsonl); this is
+    the reference's work_trsm block sweep (ref: work/work_trsm.cc)
+    reshaped so every op is a matmul."""
+    n = ad.shape[0]
+    K = n // nb
+    a_op = jnp.conj(ad) if conj else ad
+    if trans:
+        a_op = a_op.T
+    eff_lower = lower != trans
+    d = _diag_tiles(a_op, K, nb)
+    if eff_lower:
+        dinv = jax.vmap(lambda t: tri_inv_lower(t, unit_diag=unit))(d)
+    else:
+        dinv = jax.vmap(lambda t: tri_inv_upper(t, unit_diag=unit))(d)
+    xs = [None] * K
+    order = range(K) if eff_lower else range(K - 1, -1, -1)
+    for k in order:
+        k0, k1 = k * nb, (k + 1) * nb
+        acc = bd[k0:k1]
+        if eff_lower and k > 0:
+            x_done = jnp.concatenate(xs[:k], axis=0)
+            acc = acc - a_op[k0:k1, :k0] @ x_done
+        elif not eff_lower and k < K - 1:
+            x_done = jnp.concatenate(xs[k + 1:], axis=0)
+            acc = acc - a_op[k0:k1, k1:] @ x_done
+        xs[k] = dinv[k] @ acc
+    return jnp.concatenate(xs, axis=0)
+
+
+def trsm_right_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
+                       unit: bool, nb: int):
+    """Solve X op(A) = B by block substitution over block columns (right
+    side twin of trsm_left_blocked)."""
+    n = ad.shape[0]
+    K = n // nb
+    a_op = jnp.conj(ad) if conj else ad
+    if trans:
+        a_op = a_op.T
+    eff_lower = lower != trans
+    d = _diag_tiles(a_op, K, nb)
+    if eff_lower:
+        dinv = jax.vmap(lambda t: tri_inv_lower(t, unit_diag=unit))(d)
+    else:
+        dinv = jax.vmap(lambda t: tri_inv_upper(t, unit_diag=unit))(d)
+    xs = [None] * K
+    # X_k depends on later X_j for lower (B_k - sum_{j>k} X_j A[j,k]),
+    # earlier for upper
+    order = range(K - 1, -1, -1) if eff_lower else range(K)
+    for k in order:
+        k0, k1 = k * nb, (k + 1) * nb
+        acc = bd[:, k0:k1]
+        if eff_lower and k < K - 1:
+            x_done = jnp.concatenate(xs[k + 1:], axis=1)
+            acc = acc - x_done @ a_op[k1:, k0:k1]
+        elif not eff_lower and k > 0:
+            x_done = jnp.concatenate(xs[:k], axis=1)
+            acc = acc - x_done @ a_op[:k0, k0:k1]
+        xs[k] = acc @ dinv[k]
+    return jnp.concatenate(xs, axis=1)

@@ -1,0 +1,62 @@
+"""Debug dumps of tile state (analog of the reference's Debug class,
+ref: src/core/Debug.cc:66-336 checkTilesLives / printTilesLives /
+printTilesMaps, which print per-tile existence/life/MOSI state).
+
+The TPU storage model has no tile lives or MOSI states to dump (one
+sharded array, SSA — see core/storage.py); what remains debuggable is
+the MAP: which device owns each tile, what lives in it (norm), and
+whether the pad invariant holds.  These helpers print exactly that.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tiles_map(A, *, max_tiles: int = 32) -> str:
+    """Owner + per-tile Frobenius norm map (ref: Debug::printTilesMaps).
+
+    One cell per tile: ``r<rank>:<norm>``; '.' for all-zero tiles.
+    Truncated to ``max_tiles`` rows/cols like the reference's dumps."""
+    st = A.storage
+    can = np.asarray(st.canonical())
+    Mt, Nt = min(st.Mt, max_tiles), min(st.Nt, max_tiles)
+    lines = [f"tiles_map {st.m}x{st.n} mb={st.mb} nb={st.nb} "
+             f"grid={st.grid.p}x{st.grid.q}"]
+    for i in range(Mt):
+        cells = []
+        for j in range(Nt):
+            nrm = float(np.linalg.norm(can[i, j]))
+            r = st.tile_rank(i, j)
+            cells.append("." if nrm == 0 else f"r{r}:{nrm:.2e}")
+        lines.append(" ".join(cells) + (" ..." if Nt < st.Nt else ""))
+    if Mt < st.Mt:
+        lines.append("...")
+    return "\n".join(lines)
+
+
+def check_pad_invariant(A) -> bool:
+    """True iff every out-of-matrix pad entry is exactly zero — the
+    invariant every kernel preserves (the analog of Debug::checkTiles
+    consistency checking)."""
+    st = A.storage
+    can = np.asarray(st.canonical())
+    dense = can.transpose(0, 2, 1, 3).reshape(st.Mt * st.mb, st.Nt * st.nb)
+    ok = True
+    if st.Mt * st.mb > st.m:
+        ok &= not np.any(dense[st.m:, :])
+    if st.Nt * st.nb > st.n:
+        ok &= not np.any(dense[:, st.n:])
+    return bool(ok)
+
+
+def memory_report(A) -> str:
+    """Per-device HBM footprint of a matrix's storage (the analog of the
+    reference Memory pool counters, Memory.hh:29-95)."""
+    st = A.storage
+    itemsize = np.dtype(st.dtype).itemsize
+    per_dev = (st.data.size * itemsize) / max(st.grid.p * st.grid.q, 1)
+    return (f"storage {st.data.shape} {st.dtype}: "
+            f"{st.data.size * itemsize / 1e6:.2f} MB total, "
+            f"{per_dev / 1e6:.2f} MB per device over "
+            f"{st.grid.p * st.grid.q} device(s)")

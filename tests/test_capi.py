@@ -1,0 +1,121 @@
+"""Driver C API tests: build libslate_tpu_capi.so + a real C test
+program, run it in a subprocess, and check it solves gesv/posv through
+the embedded-interpreter tier (ref: src/c_api/wrappers.cc driver C API;
+test analog of the reference's c_api unit tests)."""
+
+import os
+import pathlib
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+C_MAIN = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <math.h>
+#include "slate_tpu_capi.h"
+
+int main(void) {
+  const int64_t n = 24, nrhs = 3, nb = 8;
+  double *a = (double*)malloc(n * n * sizeof(double));
+  double *b = (double*)malloc(n * nrhs * sizeof(double));
+  double *x = (double*)malloc(n * nrhs * sizeof(double));
+  unsigned s = 12345;
+  for (int64_t i = 0; i < n * n; i++) {
+    s = s * 1103515245u + 12345u;
+    a[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+  }
+  for (int64_t i = 0; i < n; i++) a[i * n + i] += (double)n;
+  for (int64_t i = 0; i < n * nrhs; i++) {
+    s = s * 1103515245u + 12345u;
+    b[i] = ((double)(s >> 8) / (1u << 24)) - 0.5;
+  }
+  if (slate_tpu_init() != 0) { printf("FAIL init\n"); return 1; }
+  if (slate_tpu_dgesv(n, nrhs, a, n, b, nrhs, x, nrhs, nb) != 0) {
+    printf("FAIL dgesv rc\n"); return 1;
+  }
+  double err = 0.0;
+  for (int64_t i = 0; i < n; i++)
+    for (int64_t j = 0; j < nrhs; j++) {
+      double r = -b[i * nrhs + j];
+      for (int64_t k = 0; k < n; k++) r += a[i * n + k] * x[k * nrhs + j];
+      if (fabs(r) > err) err = fabs(r);
+    }
+  if (err > 1e-8) { printf("FAIL resid %g\n", err); return 1; }
+  /* posv on A A^T + n I */
+  double *spd = (double*)malloc(n * n * sizeof(double));
+  for (int64_t i = 0; i < n; i++)
+    for (int64_t j = 0; j < n; j++) {
+      double v = (i == j) ? (double)n : 0.0;
+      for (int64_t k = 0; k < n; k++) v += a[i * n + k] * a[j * n + k];
+      spd[i * n + j] = v;
+    }
+  if (slate_tpu_dposv(n, nrhs, spd, n, b, nrhs, x, nrhs, nb) != 0) {
+    printf("FAIL dposv rc\n"); return 1;
+  }
+  err = 0.0;
+  for (int64_t i = 0; i < n; i++)
+    for (int64_t j = 0; j < nrhs; j++) {
+      double r = -b[i * nrhs + j];
+      for (int64_t k = 0; k < n; k++) r += spd[i * n + k] * x[k * nrhs + j];
+      if (fabs(r) > err) err = fabs(r);
+    }
+  if (err > 1e-7) { printf("FAIL posv resid %g\n", err); return 1; }
+  printf("CAPI_OK\n");
+  slate_tpu_finalize();
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_c_program_solves_through_capi(tmp_path):
+    if shutil.which("g++") is None or shutil.which("python3-config") is None:
+        pytest.skip("no native toolchain")
+    lib = tmp_path / "libslate_tpu_capi.so"
+    r = subprocess.run(["make", "-C", str(ROOT / "native"), "capi",
+                        f"CAPI={lib}"], capture_output=True, text=True, errors="replace")
+    assert r.returncode == 0, r.stderr
+    src = tmp_path / "main.c"
+    src.write_text(C_MAIN)
+    exe = tmp_path / "capi_test"
+    r = subprocess.run(
+        ["g++", str(src), "-o", str(exe),
+         f"-I{ROOT / 'native'}", str(lib), f"-Wl,-rpath,{tmp_path}"],
+        capture_output=True, text=True, errors="replace")
+    assert r.returncode == 0, r.stderr
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{ROOT}:{env.get('PYTHONPATH', '')}"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SLATE_CAPI_PLATFORM"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run([str(exe)], capture_output=True, text=True, errors="replace", env=env,
+                       timeout=600)
+    assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr[-2000:]}"
+    assert "CAPI_OK" in r.stdout
+
+
+def test_fortran_module_generated():
+    # the committed module must match the generator's output exactly
+    import sys
+    sys.path.insert(0, str(ROOT / "tools"))
+    import generate_fortran
+    committed = (ROOT / "slate_tpu" / "compat" / "slate_tpu.f90").read_text()
+    assert committed == generate_fortran.emit()
+
+
+def test_fortran_module_compiles():
+    fc = shutil.which("gfortran") or shutil.which("flang")
+    if fc is None:
+        pytest.skip("no Fortran compiler in image")
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        r = subprocess.run(
+            [fc, "-c", str(ROOT / "slate_tpu" / "compat" / "slate_tpu.f90"),
+             "-o", f"{d}/slate_tpu.o", "-J", d],
+            capture_output=True, text=True, errors="replace")
+        assert r.returncode == 0, r.stderr

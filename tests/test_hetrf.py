@@ -114,3 +114,21 @@ def test_hesv_zero_offdiag_block(rng):
                    st.Matrix.from_numpy(b, nb, nb))
     assert int(np.max(np.asarray(F.piv))) < n
     np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-8)
+
+
+@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.slow
+def test_hesv_mesh(rng, p, q):
+    # mesh Aasen: A expanded row-sharded (never replicated), hot gemm
+    # row-parallel (ref: src/hetrf.cc distributed panel/update gemms)
+    import jax
+    n, nb, nrhs = 40, 4, 3
+    g = st.Grid(p, q, devices=jax.devices()[:p * q])
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    b = rng.standard_normal((n, nrhs))
+    A = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    F, X = st.hesv(A, B)
+    x = X.to_numpy()
+    assert np.abs(a @ x - b).max() / (np.abs(a).max() * n) < 1e-11

@@ -64,3 +64,17 @@ def test_trace_span_names_phases(rng, tmp_path):
             if name in raw:
                 found.add(name.decode())
     assert "slate.posv" in found and "slate.potrf" in found, found
+
+def test_debug_tiles_map(rng):
+    from slate_tpu.util.debug import (check_pad_invariant, memory_report,
+                                      tiles_map)
+    a = rng.standard_normal((10, 7))
+    A = st.Matrix.from_numpy(a, 4, 4)
+    s = tiles_map(A)
+    assert "tiles_map 10x7" in s and "r0:" in s
+    assert check_pad_invariant(A)
+    # break the invariant on purpose: debug must catch it
+    bad = st.Matrix(type(A.storage)(
+        A.storage.data + 1.0, 10, 7, 4, 4, A.grid))
+    assert not check_pad_invariant(bad)
+    assert "MB total" in memory_report(A)

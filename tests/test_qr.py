@@ -156,3 +156,18 @@ def test_qr_multiply(rng):
     Q = st.qr_multiply(F)
     q = Q.to_numpy()[:, :n]
     np.testing.assert_allclose(q.T @ q, np.eye(n), atol=1e-12)
+
+
+def test_geqrf_complex_cholqr_panel(rng):
+    # tall complex panel with nb >= 8 drives panel_qr_cholqr (the
+    # reconstruction path needs R scaled by conj(S) — S is a unitary
+    # phase diagonal for complex data, not just signs)
+    m, n, nb = 96, 16, 16
+    a = (rng.standard_normal((m, n))
+         + 1j * rng.standard_normal((m, n)))
+    A = st.Matrix.from_numpy(a, nb, nb)
+    F = st.geqrf(A)
+    Q = st.qr_multiply(F).to_numpy()
+    R = np.triu(F.QR.to_numpy()[:n, :n])
+    np.testing.assert_allclose(Q @ R, a, atol=1e-10)
+    np.testing.assert_allclose(Q.conj().T @ Q, np.eye(n), atol=1e-11)

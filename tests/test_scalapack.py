@@ -69,3 +69,74 @@ def test_as_checkpoint_format(rng):
     A2 = from_scalapack(desc, saved, g)
     _, X = st.gesv(A2, st.Matrix.from_numpy(b, 4, 4, g))
     np.testing.assert_allclose(a @ X.to_numpy(), b, atol=1e-10)
+
+
+def _dist(a, mb, nb, g):
+    d, l = to_scalapack(st.Matrix.from_numpy(a, mb, nb, g))
+    return d, l
+
+
+def test_pdgemm_round_trip(rng):
+    # routine-level entry point vs numpy (ref: scalapack_gemm.cc)
+    from slate_tpu.compat.scalapack_api import pdgemm
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    m, k, n, mb, nb = 24, 20, 16, 4, 4
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    c = rng.standard_normal((m, n))
+    da, la = _dist(a, mb, nb, g)
+    db, lb = _dist(b, mb, nb, g)
+    dc, lc = _dist(c, mb, nb, g)
+    dout, lout = pdgemm("n", "n", m, n, k, 2.0, da, la, db, lb, 0.5,
+                        dc, lc, g)
+    C = from_scalapack(dout, lout, g).to_numpy()
+    np.testing.assert_allclose(C, 2.0 * a @ b + 0.5 * c, atol=1e-12)
+
+
+def test_pdgemm_trans(rng):
+    from slate_tpu.compat.scalapack_api import pdgemm
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    m, k, n, nb = 12, 8, 10, 4
+    a = rng.standard_normal((k, m))          # op(A) = A^T
+    b = rng.standard_normal((k, n))
+    c = np.zeros((m, n))
+    da, la = _dist(a, nb, nb, g)
+    db, lb = _dist(b, nb, nb, g)
+    dc, lc = _dist(c, nb, nb, g)
+    dout, lout = pdgemm("t", "n", m, n, k, 1.0, da, la, db, lb, 0.0,
+                        dc, lc, g)
+    C = from_scalapack(dout, lout, g).to_numpy()
+    np.testing.assert_allclose(C, a.T @ b, atol=1e-12)
+
+
+@pytest.mark.slow
+def test_pdgesv_pdposv(rng):
+    from slate_tpu.compat.scalapack_api import pdgesv, pdposv
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    n, nrhs, nb = 20, 3, 4
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, nrhs))
+    da, la = _dist(a, nb, nb, g)
+    db, lb = _dist(b, nb, nb, g)
+    dx, lx = pdgesv(n, nrhs, da, la, db, lb, g)
+    x = from_scalapack(dx, lx, g).to_numpy()
+    np.testing.assert_allclose(a @ x, b, atol=1e-9)
+    s = a @ a.T + n * np.eye(n)
+    ds, ls = _dist(s, nb, nb, g)
+    dx2, lx2 = pdposv("l", n, nrhs, ds, ls, db, lb, g)
+    x2 = from_scalapack(dx2, lx2, g).to_numpy()
+    np.testing.assert_allclose(s @ x2, b, atol=1e-8)
+
+
+@pytest.mark.slow
+def test_pdsyev(rng):
+    from slate_tpu.compat.scalapack_api import pdsyev
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    n, nb = 16, 4
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    da, la = _dist(a, nb, nb, g)
+    w, dz, lz = pdsyev("v", "l", n, da, la, g)
+    z = from_scalapack(dz, lz, g).to_numpy()
+    np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(a), atol=1e-9)
+    np.testing.assert_allclose(a @ z, z @ np.diag(w), atol=1e-9)
