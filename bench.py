@@ -6,6 +6,7 @@ building block of the 2D-grid configs) plus raw-MXU context:
   gemm   n=4096  f32  (config #1, kept for cross-round continuity)
   gemm   n=8192  f32  (larger-tile point where the chip leaves dispatch
                        overhead behind; closer to the chip's real ceiling)
+  gemm   n=16384 f32  (near-peak point: raw dot measures ~0.6 MFU here)
   posv   n=16384 f32  (config #2 family: potrf + potrs, nrhs=256)
   gesv   n=16384 f32  (config #3 family: getrf partial pivot + getrs)
   geqrf  131072x1024  (config #4: tall-skinny Householder QR)
@@ -273,6 +274,7 @@ def main():
     sys.exit(1 if _run_isolated([
         (bench_gemm, dict(n=4096, nb=256, iters=50)),
         (bench_gemm, dict(n=8192, nb=512, iters=20)),
+        (bench_gemm, dict(n=16384, nb=1024, iters=8)),
         (bench_posv, dict(n=16384, nb=512, nrhs=256, iters=5)),
         (bench_gesv, dict(n=16384, nb=512, nrhs=256, iters=4)),
         (bench_geqrf, dict(m=131072, n=1024, nb=256, iters=4)),

@@ -110,7 +110,15 @@ class TileStorage:
     def _shard(self) -> "TileStorage":
         sh = self.grid.tile_sharding()
         if sh is not None:
-            self.data = jax.device_put(self.data, sh)
+            try:
+                self.data = jax.device_put(self.data, sh)
+            except (AssertionError, ValueError):
+                # an eager compute result can carry a GSPMD (non-Named)
+                # sharding, and jax's different-device-order reshard
+                # path only accepts NamedSharding inputs; bounce through
+                # host for that cross-mesh corner (redistribute between
+                # permuted grids) — only reachable eagerly
+                self.data = jax.device_put(jax.device_get(self.data), sh)
         return self
 
     # ---- distribution lambdas (ref: MatrixStorage.hh:533-586) ----

@@ -135,6 +135,17 @@ def panel_lu_threshold(panel, tau):
     return lu, perm
 
 
+def _lu_select_ok(blocks, nb: int) -> bool:
+    """Route tournament pivot selection through the Pallas kernel
+    (internal/pallas_lu.py) — opt-in via SLATE_PALLAS=1 like the chol
+    tile kernel: on current hardware it ties, not beats, the batched XLA
+    LU (docs/PERF.md), but stays available as the selection seam."""
+    from .potrf import _pallas_ok
+    W = blocks.shape[1]
+    return (_pallas_ok() and blocks.dtype == jnp.float32
+            and nb % 128 == 0 and W % 128 == 0 and W <= 4096)
+
+
 def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     """CALU tournament pivot selection + clean factorization
     (ref: internal_getrf_tntpiv.cc, Tile_getrf_tntpiv.hh).
@@ -168,8 +179,12 @@ def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     cidx = gidx.reshape(nch, block_rows)
 
     def keep_best(blocks, idx):
-        _, _, pb = jax.vmap(lax.linalg.lu)(blocks)
-        take = pb[:, :nb]
+        if _lu_select_ok(blocks, nb):
+            from .pallas_lu import lu_select_pallas
+            take = jax.vmap(lu_select_pallas)(blocks)
+        else:
+            _, _, pb = jax.vmap(lax.linalg.lu)(blocks)
+            take = pb[:, :nb]
         return (jnp.take_along_axis(blocks, take[:, :, None], axis=1),
                 jnp.take_along_axis(idx, take, axis=1))
 
@@ -193,8 +208,10 @@ def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     # nb DISTINCT in-range rows (a naive slot-index fallback can collide
     # with a genuinely chosen row and silently drop a matrix row)
     valid = chosen < W
+    # scatter sentinels OUT of range (mode="drop") — aliasing them to a
+    # real index races a True and a False onto that slot
     in_ch0 = jnp.zeros((W,), jnp.bool_).at[
-        jnp.where(valid, chosen, 0)].set(valid)
+        jnp.where(valid, chosen, W)].set(True, mode="drop")
     free = jnp.sort(jnp.where(in_ch0, W + iota, iota))
     kfree = jnp.cumsum(~valid) - 1
     chosen = jnp.where(valid, chosen,

@@ -70,6 +70,7 @@ def test_potrf_not_spd_traced_nan(rng):
     assert not bool(jnp.all(jnp.isfinite(out)))
 
 
+@pytest.mark.slow
 def test_mixed_no_fallback_reports_nonconvergence(rng):
     # ill-conditioned system: f32-factor IR cannot reach f64 accuracy; with
     # the fallback disabled the documented contract is converged=False with

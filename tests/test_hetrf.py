@@ -19,8 +19,14 @@ def herm_indef(rng, n, dtype=np.float64):
     return a
 
 
-@pytest.mark.parametrize("n,nb", [(16, 4), (23, 5), (8, 8), (1, 4), (2, 4),
-                                  (40, 8)])
+@pytest.mark.parametrize("n,nb", [
+    (16, 4), (2, 4),
+    # every distinct (n, nb) costs ~10-60 s of single-core eager
+    # compile on the CPU tier; broader shapes run in the slow tier
+    pytest.param(23, 5, marks=pytest.mark.slow),
+    pytest.param(8, 8, marks=pytest.mark.slow),
+    pytest.param(1, 4, marks=pytest.mark.slow),
+    pytest.param(40, 8, marks=pytest.mark.slow)])
 def test_hetrf_residual(rng, n, nb):
     a = herm_indef(rng, n)
     A = st.SymmetricMatrix.from_numpy(a, nb)
@@ -45,6 +51,7 @@ def test_hetrf_residual(rng, n, nb):
                 np.tril(np.asarray(F.Tsub[j]), -1), 0, atol=0)
 
 
+@pytest.mark.slow
 def test_hetrf_complex(rng):
     n, nb = 14, 4
     a = herm_indef(rng, n, np.complex128)
@@ -56,7 +63,8 @@ def test_hetrf_complex(rng):
                                atol=1e-10)
 
 
-@pytest.mark.parametrize("n,nb,nrhs", [(16, 4, 3), (25, 8, 1)])
+@pytest.mark.parametrize("n,nb,nrhs", [
+    (16, 4, 3), pytest.param(25, 8, 1, marks=pytest.mark.slow)])
 def test_hesv(rng, n, nb, nrhs):
     a = herm_indef(rng, n)
     b = rng.standard_normal((n, nrhs))

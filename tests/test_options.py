@@ -39,6 +39,7 @@ def test_pivot_threshold_prefers_diagonal(rng):
     np.testing.assert_array_equal(np.asarray(F.perm), np.arange(n))
 
 
+@pytest.mark.slow
 def test_tournament_mpt_depth(rng):
     n, nb = 40, 4
     a = rng.standard_normal((n, n))

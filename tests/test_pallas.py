@@ -15,3 +15,24 @@ def test_pallas_chol_interpret(rng, n, bw):
     L = np.asarray(chol_tile_pallas(jnp.asarray(a), bw=bw, interpret=True))
     np.testing.assert_allclose(L, np.linalg.cholesky(a), atol=5e-6)
     assert np.all(np.triu(L, 1) == 0)      # exact-zero upper contract
+
+
+@pytest.mark.parametrize("W,nb", [(256, 32), (1024, 128)])
+def test_pallas_lu_select_interpret(rng, W, nb):
+    # pivot order must match the XLA LU oracle exactly
+    from jax import lax
+    from slate_tpu.internal.pallas_lu import lu_select_pallas
+    a = jnp.asarray(rng.standard_normal((W, nb)).astype(np.float32))
+    piv = np.asarray(lu_select_pallas(a, interpret=True))
+    ref = np.asarray(lax.linalg.lu(a)[2])[:nb]
+    np.testing.assert_array_equal(piv, ref)
+
+
+def test_pallas_lu_select_ragged_interpret(rng):
+    from jax import lax
+    from slate_tpu.internal.pallas_lu import lu_select_pallas
+    a = jnp.asarray(rng.standard_normal((160, 32)).astype(np.float32))
+    ap = jnp.zeros((256, 32), jnp.float32).at[:160].set(a)
+    piv = np.asarray(lu_select_pallas(ap, nrows=160, interpret=True))
+    ref = np.asarray(lax.linalg.lu(a)[2])[:32]
+    np.testing.assert_array_equal(piv, ref)

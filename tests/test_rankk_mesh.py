@@ -13,9 +13,11 @@ def _grid(p, q):
     return st.Grid(p, q, devices=jax.devices()[: p * q])
 
 
-@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("p,q", [
+    (2, 2), pytest.param(2, 4, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
-@pytest.mark.parametrize("n,k,nb", [(24, 16, 4), (22, 13, 5)])
+@pytest.mark.parametrize("n,k,nb", [
+    (24, 16, 4), pytest.param(22, 13, 5, marks=pytest.mark.slow)])
 def test_herk_mesh(rng, p, q, uplo, n, k, nb):
     g = _grid(p, q)
     a = rng.standard_normal((n, k))
@@ -115,7 +117,8 @@ def test_trmm_mesh_ragged(rng):
                                rtol=1e-11, atol=1e-11)
 
 
-@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("p,q", [
+    (2, 2), pytest.param(2, 4, marks=pytest.mark.slow)])
 def test_gemmA_mesh(rng, p, q):
     g = _grid(p, q)
     m, k, nb = 32, 24, 4

@@ -28,10 +28,12 @@ def test_stedc_random(rng):
     _check(rng.standard_normal(100), rng.standard_normal(99))
 
 
+@pytest.mark.slow
 def test_stedc_odd_size(rng):
     _check(rng.standard_normal(97), rng.standard_normal(96))
 
 
+@pytest.mark.slow
 def test_stedc_near_diagonal(rng):
     _check(np.ones(64), np.full(63, 1e-14))
 
@@ -40,6 +42,7 @@ def test_stedc_exact_diagonal():
     _check(np.arange(48.0), np.zeros(47))
 
 
+@pytest.mark.slow
 def test_stedc_glued_wilkinson():
     # three glued W21+ blocks: clustered pairs + weak coupling, the classic
     # D&C deflation stress (ref: stedc_deflate.cc)
@@ -66,6 +69,7 @@ def test_stedc_single():
     assert float(np.asarray(w)[0]) == 3.0
 
 
+@pytest.mark.slow
 def test_stedc_jits(rng):
     import jax
     d = rng.standard_normal(40)

@@ -20,14 +20,15 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-OUT = open("/root/repo/docs/ceiling.jsonl", "a", buffering=1)
+OUT = open(os.path.join(_ROOT, "docs", "ceiling.jsonl"), "a", buffering=1)
 
 
 def time_chain(body, init, args, iters, reps=3):
