@@ -114,3 +114,219 @@ def gecon(a, opts=None):
     from ..types import Norm
     A = _mat(a, opts=opts)
     return float(gecondest(_getrf(A, opts), _norm(Norm.One, A)))
+
+
+# ---- BLAS-3 tier (ref: lapack_api/lapack_gemm.cc, _hemm, _herk, _her2k,
+# _symm, _syrk, _syr2k, _trmm, _trsm) ----
+
+def _op_mat(a, trans: str, opts=None) -> Matrix:
+    return _apply_trans(_mat(a, opts=opts), trans)
+
+
+def _uplo(uplo: str) -> Uplo:
+    return Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+
+
+def gemm(transa, transb, alpha, a, b, beta=0.0, c=None, opts=None):
+    """C = alpha op(A) op(B) + beta C (LAPACK-style dgemm)."""
+    from ..drivers.blas3 import gemm as _gemm
+    C = None if c is None else _mat(c, opts=opts)
+    out = _gemm(alpha, _op_mat(a, transa, opts), _op_mat(b, transb, opts),
+                beta, C, opts)
+    return np.asarray(out.to_numpy())
+
+
+def hemm(side, uplo, alpha, a, b, beta=0.0, c=None, opts=None):
+    """C = alpha A B + beta C with A Hermitian (dhemm/zhemm)."""
+    from ..drivers.blas3 import hemm as _hemm
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
+                                   uplo=_uplo(uplo))
+    C = None if c is None else _mat(c, opts=opts)
+    return np.asarray(_hemm(side, alpha, A, _mat(b, opts=opts), beta, C,
+                            opts).to_numpy())
+
+
+def symm(side, uplo, alpha, a, b, beta=0.0, c=None, opts=None):
+    """C = alpha A B + beta C with A SYMMETRIC (dsymm/zsymm) — a complex
+    symmetric A must expand as tri + tri^T, NOT conj-mirrored like the
+    Hermitian wrapper would."""
+    from ..core.matrix import SymmetricMatrix
+    from ..drivers.blas3 import symm as _symm
+    A = SymmetricMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
+                                   uplo=_uplo(uplo))
+    C = None if c is None else _mat(c, opts=opts)
+    return np.asarray(_symm(side, alpha, A, _mat(b, opts=opts), beta, C,
+                            opts).to_numpy())
+
+
+def _rank_k(kind, uplo, alpha, a, beta, c, opts, b=None):
+    from ..core.matrix import SymmetricMatrix
+    from ..drivers import blas3
+    herm = kind in ("herk", "her2k")
+    cls = HermitianMatrix if herm else SymmetricMatrix
+    n = np.asarray(a).shape[0]
+    cm = (np.zeros((n, n), np.asarray(a).dtype) if c is None
+          else np.asarray(c))
+    C = cls.from_numpy(cm, _nb(len(cm), opts), uplo=_uplo(uplo))
+    A = _mat(a, opts=opts)
+    if kind == "herk":
+        out = blas3.herk(alpha, A, beta, C, opts)
+    elif kind == "syrk":
+        out = blas3.syrk(alpha, A, beta, C, opts)
+    elif kind == "her2k":
+        out = blas3.her2k(alpha, A, _mat(b, opts=opts), beta, C, opts)
+    else:
+        out = blas3.syr2k(alpha, A, _mat(b, opts=opts), beta, C, opts)
+    return np.asarray(out.general().to_numpy())
+
+
+def herk(uplo, alpha, a, beta=0.0, c=None, opts=None):
+    """C = alpha A A^H + beta C, C Hermitian (zherk).  Returns the full
+    (Hermitian-completed) array."""
+    return _rank_k("herk", uplo, alpha, a, beta, c, opts)
+
+
+def syrk(uplo, alpha, a, beta=0.0, c=None, opts=None):
+    """C = alpha A A^T + beta C, C symmetric (dsyrk)."""
+    return _rank_k("syrk", uplo, alpha, a, beta, c, opts)
+
+
+def her2k(uplo, alpha, a, b, beta=0.0, c=None, opts=None):
+    """C = alpha A B^H + conj(alpha) B A^H + beta C (zher2k)."""
+    return _rank_k("her2k", uplo, alpha, a, beta, c, opts, b=b)
+
+
+def syr2k(uplo, alpha, a, b, beta=0.0, c=None, opts=None):
+    """C = alpha A B^T + alpha B A^T + beta C (dsyr2k)."""
+    return _rank_k("syr2k", uplo, alpha, a, beta, c, opts, b=b)
+
+
+def _apply_trans(M, trans: str):
+    """op() dispatch shared by every shim taking a trans character."""
+    t = trans.lower()
+    if t.startswith("t"):
+        return M.transpose()
+    if t.startswith("c"):
+        return M.conj_transpose()
+    return M
+
+
+def _tri_mat(a, uplo, diag, opts):
+    from ..core.matrix import TriangularMatrix
+    from ..types import Diag
+    A = _mat(a, opts=opts)
+    return TriangularMatrix._from_view(
+        A, _uplo(uplo),
+        Diag.Unit if diag.upper().startswith("U") else Diag.NonUnit)
+
+
+def trmm(side, uplo, transa, diag, alpha, a, b, opts=None):
+    """B = alpha op(A) B or alpha B op(A), A triangular (dtrmm)."""
+    from ..drivers.blas3 import trmm as _trmm
+    T = _apply_trans(_tri_mat(a, uplo, diag, opts), transa)
+    return np.asarray(_trmm(side, alpha, T, _mat(b, opts=opts),
+                            opts).to_numpy())
+
+
+def trsm(side, uplo, transa, diag, alpha, a, b, opts=None):
+    """Solve op(A) X = alpha B or X op(A) = alpha B (dtrsm)."""
+    from ..drivers.blas3 import trsm as _trsm
+    T = _apply_trans(_tri_mat(a, uplo, diag, opts), transa)
+    return np.asarray(_trsm(side, alpha, T, _mat(b, opts=opts),
+                            opts).to_numpy())
+
+
+# ---- norms (ref: lapack_api/lapack_lange.cc, _lanhe, _lansy, _lantr) ----
+
+def _norm_kind(norm):
+    """LAPACK norm character -> Norm enum, shared by the lan* shims."""
+    from ..types import Norm
+    return {"m": Norm.Max, "1": Norm.One, "o": Norm.One, "i": Norm.Inf,
+            "f": Norm.Fro, "e": Norm.Fro}[str(norm).lower()]
+
+
+def lange(norm, a, opts=None):
+    """General matrix norm: 'm'|'1'|'i'|'f' (dlange)."""
+    from ..drivers.auxiliary import norm as _norm
+    from ..types import Norm
+    m = _norm_kind(norm)
+    return float(_norm(m, _mat(a, opts=opts)))
+
+
+def lanhe(norm, uplo, a, opts=None):
+    """Hermitian matrix norm (zlanhe)."""
+    from ..drivers.auxiliary import norm as _norm
+    from ..types import Norm
+    m = _norm_kind(norm)
+    A = HermitianMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
+                                   uplo=_uplo(uplo))
+    return float(_norm(m, A))
+
+
+def lansy(norm, uplo, a, opts=None):
+    """Symmetric matrix norm (dlansy)."""
+    from ..core.matrix import SymmetricMatrix
+    from ..drivers.auxiliary import norm as _norm
+    from ..types import Norm
+    m = _norm_kind(norm)
+    A = SymmetricMatrix.from_numpy(np.asarray(a), _nb(len(a), opts),
+                                   uplo=_uplo(uplo))
+    return float(_norm(m, A))
+
+
+def lantr(norm, uplo, diag, a, opts=None):
+    """Triangular matrix norm (dlantr)."""
+    from ..drivers.auxiliary import norm as _norm
+    from ..types import Norm
+    m = _norm_kind(norm)
+    return float(_norm(m, _tri_mat(a, uplo, diag, opts)))
+
+
+# ---- solves/inverses from factors (ref: lapack_api/lapack_getrs.cc,
+# _getri, _potri, _gesv_mixed) ----
+
+def getrs(lu, perm, b, trans: str = "n", opts=None):
+    """Solve op(A) X = B from getrf's (lu, perm) (dgetrs)."""
+    from ..drivers.lu import LUFactors, getrs as _getrs
+    from ..drivers.blas3 import trsm as _t
+    lu = np.asarray(lu)
+    perm = np.asarray(perm)
+    F = LUFactors(_mat(lu, opts=opts), perm)
+    t = trans.lower()
+    if t.startswith("n"):
+        return np.asarray(_getrs(F, _mat(b, opts=opts), opts).to_numpy())
+    # op(A) x = b with A[perm] = L U:  op(A) = op(U) op(L) P, so
+    # w = op(U)^-1 b, v = op(L)^-1 w, x[perm] = v
+    op = "c" if t.startswith("c") else "t"
+    U = F.upper().conj_transpose() if op == "c" else F.upper().transpose()
+    L = F.lower().conj_transpose() if op == "c" else F.lower().transpose()
+    w = _t("l", 1.0, U, _mat(b, opts=opts), opts)
+    v = np.asarray(_t("l", 1.0, L, w, opts).to_numpy())
+    x = np.zeros_like(v)
+    x[perm] = v
+    return x
+
+
+def getri(lu, perm, opts=None):
+    """Matrix inverse from getrf factors (dgetri)."""
+    from ..drivers.lu import LUFactors, getri as _getri
+    F = LUFactors(_mat(np.asarray(lu), opts=opts), np.asarray(perm))
+    return np.asarray(_getri(F, opts).to_numpy())
+
+
+def potri(l, uplo: str = "L", opts=None):
+    """Inverse from the Cholesky factor (dpotri).  Returns the full
+    (Hermitian-completed) inverse."""
+    from ..core.matrix import TriangularMatrix
+    from ..drivers.cholesky import potri as _potri
+    T = TriangularMatrix._from_view(_mat(np.asarray(l), opts=opts),
+                                    _uplo(uplo))
+    return np.asarray(_potri(T, opts).general().to_numpy())
+
+
+def gesv_mixed(a, b, opts=None):
+    """Mixed-precision iterative-refinement solve (dsgesv analog).
+    Returns (x, iters)."""
+    from ..drivers.mixed import gesv_mixed as _gm
+    res = _gm(_mat(a, opts=opts), _mat(b, opts=opts), opts)
+    return np.asarray(res.X.to_numpy()), int(np.asarray(res.iters))

@@ -116,3 +116,61 @@ def test_lapack_shims(rng):
     np.testing.assert_allclose(u[:, :n] * s @ vh[:n], a, atol=1e-9)
     rc = lapack.gecon(a)
     assert 0 < rc <= 1
+
+
+def test_lapack_shims_blas3(rng):
+    # the BLAS-3 tier of the LAPACK compat shims vs numpy
+    from slate_tpu.compat import lapack as lp
+    m, k, n = 12, 9, 10
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    c = rng.standard_normal((m, n))
+    np.testing.assert_allclose(lp.gemm("n", "n", 2.0, a, b, 0.5, c),
+                               2 * a @ b + 0.5 * c, atol=1e-12)
+    np.testing.assert_allclose(lp.gemm("t", "n", 1.0, a.T.copy(), b),
+                               a @ b, atol=1e-12)
+    h = rng.standard_normal((m, m))
+    h = (h + h.T) / 2
+    np.testing.assert_allclose(lp.hemm("l", "l", 1.0, h, a),
+                               h @ a, atol=1e-12)
+    np.testing.assert_allclose(lp.syrk("l", 1.0, a), a @ a.T, atol=1e-12)
+    bb = rng.standard_normal((m, k))
+    np.testing.assert_allclose(lp.syr2k("u", 1.0, a, bb),
+                               a @ bb.T + bb @ a.T, atol=1e-12)
+    t = np.tril(rng.standard_normal((m, m))) + m * np.eye(m)
+    x = lp.trsm("l", "l", "n", "n", 1.0, t, c)
+    np.testing.assert_allclose(t @ x, c, atol=1e-10)
+    np.testing.assert_allclose(lp.trmm("l", "l", "t", "n", 1.0, t, c),
+                               t.T @ c, atol=1e-12)
+
+
+def test_lapack_shims_norms_and_factors(rng):
+    from slate_tpu.compat import lapack as lp
+    n = 12
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    np.testing.assert_allclose(lp.lange("1", a),
+                               np.abs(a).sum(axis=0).max(), atol=1e-12)
+    np.testing.assert_allclose(lp.lange("f", a),
+                               np.linalg.norm(a), atol=1e-12)
+    h = (a + a.T) / 2
+    np.testing.assert_allclose(lp.lanhe("i", "l", h),
+                               np.abs(h).sum(axis=1).max(), atol=1e-12)
+    t = np.tril(a)
+    np.testing.assert_allclose(lp.lantr("m", "l", "n", t),
+                               np.abs(t).max(), atol=1e-12)
+    # getrs (incl. transpose) / getri from getrf factors
+    lu, perm = lp.getrf(a)
+    b = rng.standard_normal((n, 3))
+    np.testing.assert_allclose(a @ lp.getrs(lu, perm, b), b, atol=1e-9)
+    np.testing.assert_allclose(a.T @ lp.getrs(lu, perm, b, trans="t"),
+                               b, atol=1e-9)
+    np.testing.assert_allclose(a @ lp.getri(lu, perm), np.eye(n),
+                               atol=1e-9)
+    # potri from the Cholesky factor
+    s = a @ a.T + n * np.eye(n)
+    L = lp.potrf(s)
+    np.testing.assert_allclose(s @ lp.potri(L), np.eye(n), atol=1e-8)
+    # mixed-precision refinement solve
+    x, its = lp.gesv_mixed(s, b)
+    np.testing.assert_allclose(s @ x, b, atol=1e-8)
+    assert its >= 1

@@ -49,10 +49,16 @@ def _grid(spec: str) -> st.Grid | None:
     return st.Grid(p, q, devices=jax.devices()[: p * q])
 
 
-def _gflop(routine, n):
+def _gflop(routine, n, nb=16):
+    kd = max(2, nb // 2)                     # run_pbsv's bandwidth choice
     return {"gemm": 2 * n ** 3, "posv": n ** 3 / 3 + 2 * n ** 2,
             "gesv": 2 * n ** 3 / 3 + 2 * n ** 2,
-            "norm": n ** 2, "geqrf": 4 * n ** 3 / 3,
+            "gesv_tntpiv": 2 * n ** 3 / 3 + 2 * n ** 2,
+            "hesv": n ** 3 / 3 + 2 * n ** 2,
+            "trsm": 2 * n ** 2 * 6, "herk": n ** 2 * (n // 2 + 1),
+            "pbsv": n * kd * (kd + 2) + 4 * n * kd * 4,
+            "getri": 2 * n ** 3,
+            "norm": n ** 2, "geqrf": 10 * n ** 3 / 3,  # runner is 2n x n
             "gels": 4 * n ** 3 / 3,
             "heev": 4 * n ** 3 / 3, "svd": 4 * n ** 3 / 3}.get(routine,
                                                                n ** 3) / 1e9
@@ -99,8 +105,156 @@ def run_norm(n, nb, grid, dtype):
     return err, err < 1e-8
 
 
+def _f64(dtype):
+    return dtype in (np.float64, np.complex128)
+
+
+def run_gesv_tntpiv(n, nb, grid, dtype):
+    A = generate_matrix("rand_dominant", n, n, nb, seed=1, dtype=dtype,
+                        grid=grid)
+    B = generate_matrix("randn", n, 8, nb, seed=2, dtype=dtype, grid=grid)
+    _, X = st.gesv(A, B, {st.Option.MethodLU: st.MethodLU.CALU})
+    a, b, x = A.to_numpy(), B.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                       np.linalg.norm(x) * n)
+    return err, err < (1e-14 if _f64(dtype) else 1e-4)
+
+
+def run_hesv(n, nb, grid, dtype):
+    A = generate_hermitian("heev", n, nb, seed=1, dtype=dtype, cond=50.0,
+                           grid=grid)
+    B = generate_matrix("randn", n, 4, nb, seed=2, dtype=dtype, grid=grid)
+    _, X = st.hesv(A, B)
+    a, b, x = A.to_numpy(), B.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                       np.linalg.norm(x) * n)
+    return err, err < (1e-11 if _f64(dtype) else 1e-3)
+
+
+def run_trsm(n, nb, grid, dtype):
+    A = generate_matrix("randn", n, n, nb, seed=1, dtype=dtype, grid=grid)
+    T = st.Matrix.from_numpy(
+        np.tril(A.to_numpy()) + n * np.eye(n, dtype=dtype), nb, nb,
+        grid).triangular(st.Uplo.Lower)
+    B = generate_matrix("randn", n, 6, nb, seed=2, dtype=dtype, grid=grid)
+    X = st.trsm("l", 1.0, T, B)
+    t, b, x = T.to_numpy(), B.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(t @ x - b) / (np.linalg.norm(t) *
+                                       np.linalg.norm(x) + 1)
+    return err, err < (1e-14 if _f64(dtype) else 1e-5)
+
+
+def run_herk(n, nb, grid, dtype):
+    A = generate_matrix("randn", n, n // 2 + 1, nb, seed=1, dtype=dtype,
+                        grid=grid)
+    C0 = generate_hermitian("poev", n, nb, seed=2, dtype=dtype, cond=10.0,
+                            grid=grid)
+    C = st.herk(1.0, A, 0.5, C0)
+    a, c0 = A.to_numpy(), C0.to_numpy()
+    ref = a @ a.conj().T + 0.5 * c0
+    err = np.linalg.norm(C.general().to_numpy() - ref) / (
+        np.linalg.norm(ref) + 1)
+    return err, err < (1e-13 if _f64(dtype) else 1e-5)
+
+
+def run_geqrf(n, nb, grid, dtype):
+    m = 2 * n
+    A = generate_matrix("randn", m, n, nb, seed=1, dtype=dtype, grid=grid)
+    F = st.geqrf(A)
+    Q = st.qr_multiply(F).to_numpy()
+    R = np.triu(F.QR.to_numpy()[:n, :n])
+    a = A.to_numpy()
+    err = np.linalg.norm(Q @ R - a) / (np.linalg.norm(a) + 1)
+    orth = np.linalg.norm(Q.conj().T @ Q - np.eye(n))
+    err = max(err, orth / n)
+    return err, err < (1e-13 if _f64(dtype) else 1e-5)
+
+
+def run_pbsv(n, nb, grid, dtype):
+    if grid is not None:
+        return None                          # packed band is single-device
+    kd = max(2, nb // 2)
+    rng = np.random.default_rng(3)
+    a = np.zeros((n, n), dtype)
+    for d in range(kd + 1):
+        v = rng.standard_normal(n - d).astype(dtype) * 0.1
+        a += np.diag(v, -d)
+    a = a + a.conj().T + (2 * kd + 4) * np.eye(n, dtype=dtype)
+    A = st.HermitianBandMatrix.from_numpy(a, kd, nb)
+    b = rng.standard_normal((n, 4)).astype(dtype)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    _, X = st.pbsv(A, B)
+    x = X.to_numpy()
+    err = np.linalg.norm(a @ x - b) / (np.linalg.norm(a) *
+                                       np.linalg.norm(x) * n)
+    return err, err < (1e-14 if _f64(dtype) else 1e-5)
+
+
+def run_getri(n, nb, grid, dtype):
+    A = generate_matrix("rand_dominant", n, n, nb, seed=1, dtype=dtype,
+                        grid=grid)
+    X = st.getriOOP(A)
+    a, x = A.to_numpy(), X.to_numpy()
+    err = np.linalg.norm(a @ x - np.eye(n)) / n
+    return err, err < (1e-12 if _f64(dtype) else 1e-4)
+
+
 RUNNERS = {"gemm": run_gemm, "posv": run_posv, "gesv": run_gesv,
-           "norm": run_norm}
+           "gesv_tntpiv": run_gesv_tntpiv, "hesv": run_hesv,
+           "trsm": run_trsm, "herk": run_herk, "geqrf": run_geqrf,
+           "pbsv": run_pbsv, "getri": run_getri, "norm": run_norm}
+
+
+# ---- scipy reference-library cross-checks (the testsweeper --ref mode:
+# compare RESULTS against the reference library, not just residual
+# identities; ref: test/run_tests.py --ref) ----
+
+def ref_gesv(n, nb, grid, dtype):
+    import scipy.linalg
+    A = generate_matrix("rand_dominant", n, n, nb, seed=1, dtype=dtype,
+                        grid=grid)
+    B = generate_matrix("randn", n, 8, nb, seed=2, dtype=dtype, grid=grid)
+    _, X = st.gesv(A, B)
+    xr = scipy.linalg.solve(A.to_numpy(), B.to_numpy())
+    err = np.linalg.norm(X.to_numpy() - xr) / (np.linalg.norm(xr) + 1)
+    return err, err < (1e-11 if _f64(dtype) else 1e-3)
+
+
+def ref_heev(n, nb, grid, dtype):
+    import scipy.linalg
+    A = generate_hermitian("heev", n, nb, seed=1, dtype=dtype, cond=100.0,
+                           grid=grid)
+    lam, _ = st.heev(A)
+    wr = scipy.linalg.eigh(A.to_numpy(), eigvals_only=True)
+    err = np.max(np.abs(np.sort(np.asarray(lam)) - wr)) / (
+        np.abs(wr).max() + 1e-300)
+    return err, err < (1e-11 if _f64(dtype) else 1e-4)
+
+
+def ref_svd(n, nb, grid, dtype):
+    import scipy.linalg
+    A = generate_matrix("svd", n, n, nb, seed=1, dtype=dtype, cond=100.0,
+                        grid=grid)
+    s = st.svd_vals(A)
+    sr = scipy.linalg.svdvals(A.to_numpy())
+    err = np.max(np.abs(np.sort(np.asarray(s))[::-1] - sr)) / (
+        sr.max() + 1e-300)
+    return err, err < (1e-11 if _f64(dtype) else 1e-4)
+
+
+def ref_gels(n, nb, grid, dtype):
+    import scipy.linalg
+    m = 2 * n
+    A = generate_matrix("randn", m, n, nb, seed=1, dtype=dtype, grid=grid)
+    B = generate_matrix("randn", m, 4, nb, seed=2, dtype=dtype, grid=grid)
+    X = st.gels(A, B)
+    xr = scipy.linalg.lstsq(A.to_numpy(), B.to_numpy())[0]
+    err = np.linalg.norm(X.to_numpy()[:n] - xr) / (np.linalg.norm(xr) + 1)
+    return err, err < (1e-9 if _f64(dtype) else 1e-3)
+
+
+REF_RUNNERS = {"gesv": ref_gesv, "heev": ref_heev, "svd": ref_svd,
+               "gels": ref_gels}
 
 
 def _late_runners():
@@ -146,16 +300,25 @@ def _late_runners():
 
 
 def main(argv=None):
-    ap = argparse.ArgumentParser()
+    # @file arguments are testsweeper-style per-routine parameter files
+    # (one flag/argument per line; see tools/params/*.txt)
+    ap = argparse.ArgumentParser(fromfile_prefix_chars="@")
     ap.add_argument("routines", nargs="+")
     ap.add_argument("--dims", default="64,128")
     ap.add_argument("--nb", default="16")
     ap.add_argument("--grids", default="1x1,2x2")
     ap.add_argument("--type", default="d", help="s,d,c,z")
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--ref", action="store_true",
+                    help="cross-check RESULTS against scipy (the "
+                         "reference-library comparison mode) where a "
+                         "ref runner exists")
     args = ap.parse_args(argv)
 
     RUNNERS.update(_late_runners())
+    if args.ref:
+        for name, fn in REF_RUNNERS.items():
+            RUNNERS[name] = fn
     routines = list(RUNNERS) if args.routines == ["all"] else args.routines
     dims = [int(x) for x in args.dims.split(",")]
     nbs = [int(x) for x in args.nb.split(",")]
@@ -178,7 +341,7 @@ def main(argv=None):
                         grid = _grid(gspec)
                         t0 = time.perf_counter()
                         try:
-                            err, ok = fn(n, nb, grid, dtype)
+                            res = fn(n, nb, grid, dtype)
                         except Exception as e:  # noqa: BLE001
                             print(f"{routine:8} {_TCODE[dtype]:4} "
                                   f"{n:6} {nb:4} {gspec:>5} "
@@ -186,8 +349,14 @@ def main(argv=None):
                                   f"ERROR {type(e).__name__}: {e}")
                             failures += 1
                             continue
+                        if res is None:      # config not applicable
+                            print(f"{routine:8} {_TCODE[dtype]:4} {n:6} "
+                                  f"{nb:4} {gspec:>5} {'-':>9} {'-':>9} "
+                                  f"{'-':>10}  skip")
+                            continue
+                        err, ok = res
                         dt = time.perf_counter() - t0
-                        gf = _gflop(routine, n) / dt
+                        gf = _gflop(routine, n, nb) / dt
                         status = "pass" if ok else "FAILED"
                         failures += 0 if ok else 1
                         print(f"{routine:8} {_TCODE[dtype]:4} {n:6} "
