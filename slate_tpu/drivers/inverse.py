@@ -8,45 +8,44 @@ potri, src/getri.cc / src/getriOOP.cc LU-based inverse).
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import lax
 
 from ..core.matrix import (BaseTrapezoidMatrix, HermitianMatrix, Matrix,
                            TriangularMatrix)
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
 from ..options import Options
-from ..types import Diag, Op, Uplo
+from ..types import Uplo
 
 
 def trtri(A: TriangularMatrix, opts: Options | None = None):
-    """Triangular inverse (ref: src/trtri.cc).  Solves op(T) X = I with one
-    statically-shaped triangular_solve — the blocked recursion the reference
-    hand-codes is what XLA's lowering performs internally."""
+    """Triangular inverse (ref: src/trtri.cc).  Solves op(T) X = I
+    through the trsm driver, so the execution target follows trsm's:
+    the dist_trsm substitution pipeline on a mesh (the reference's
+    distributed trtri, src/trtri.cc:1-160), blocked substitution with
+    batched diagonal inverses single-target."""
+    from .blas3 import trsm
     slate_error(isinstance(A, BaseTrapezoidMatrix), "trtri: need triangular")
     n = A.m
-    ad = A._dense_store()
-    lower = A.uplo is Uplo.Lower
+    nb = A.storage.nb
     eye = jnp.eye(n, dtype=A.dtype)
-    inv = lax.linalg.triangular_solve(
-        ad, eye, left_side=True, lower=lower,
-        transpose_a=(A.op is not Op.NoTrans),
-        conjugate_a=(A.op is Op.ConjTrans),
-        unit_diagonal=A.diag is Diag.Unit)
+    I = Matrix(TileStorage.from_dense(eye, nb, nb, A.grid))
+    X = trsm("l", 1.0, A, I, opts)
     # result has the effective (logical) triangle of op(A)
-    eff_lower = lower if A.op is Op.NoTrans else not lower
-    st = TileStorage.from_dense(inv, A.storage.nb, A.storage.nb, A.grid)
+    eff_lower = A._uplo_logical() is Uplo.Lower
     return TriangularMatrix._from_view(
-        Matrix(st), Uplo.Lower if eff_lower else Uplo.Upper, A.diag)
+        X, Uplo.Lower if eff_lower else Uplo.Upper, A.diag)
 
 
 def trtrm(L: TriangularMatrix, opts: Options | None = None):
     """Hermitian product of a triangular factor with its adjoint
     (ref: src/trtrm.cc).  For Linv lower: returns Linv^H Linv, i.e. the
-    second half of potri."""
-    ld = L.to_dense()
+    second half of potri — computed through the herk driver, so the
+    mesh path is the triangle-aware distributed rank-k kernel."""
+    from .blas3 import herk
+    n = L.m
+    nb = L.storage.nb
+    C0 = HermitianMatrix._from_view(
+        Matrix.zeros(n, n, nb, nb, L.grid, L.dtype), Uplo.Lower)
     if L._uplo_logical() is Uplo.Lower:
-        full = jnp.conj(ld).T @ ld
-    else:
-        full = ld @ jnp.conj(ld).T
-    st = TileStorage.from_dense(full, L.storage.nb, L.storage.nb, L.grid)
-    return HermitianMatrix._from_view(Matrix(st), Uplo.Lower)
+        return herk(1.0, L.conj_transpose().general(), 0.0, C0, opts)
+    return herk(1.0, L.general(), 0.0, C0, opts)

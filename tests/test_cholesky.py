@@ -210,3 +210,23 @@ def test_herk_rejects_general_C(rng):
         assert False, "expected SlateValueError"
     except st.SlateValueError:
         pass
+
+
+@pytest.mark.slow
+def test_potri_getri_mesh(rng):
+    # inverses ride the distributed trsm/herk kernels on a mesh
+    # (ref: src/trtri.cc, src/getri.cc distribute)
+    import jax
+    n, nb = 24, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a0 = rng.standard_normal((n, n))
+    s = a0 @ a0.T + n * np.eye(n)
+    S = st.HermitianMatrix.from_numpy(s, nb, st.Uplo.Lower, g)
+    L = st.potrf(S)
+    Sinv = st.potri(L)
+    np.testing.assert_allclose(s @ Sinv.general().to_numpy(), np.eye(n),
+                               atol=1e-9)
+    A = st.Matrix.from_numpy(a0 + n * np.eye(n), nb, nb, g)
+    X = st.getriOOP(A)
+    np.testing.assert_allclose((a0 + n * np.eye(n)) @ X.to_numpy(),
+                               np.eye(n), atol=1e-9)
