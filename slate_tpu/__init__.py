@@ -11,16 +11,19 @@ ref: /root/reference README.md:15-37), re-designed for TPU:
 - mixed-precision (f32 factor + f64 refine) is the native high-precision path.
 """
 
+from .util import compat_jax as _compat_jax  # noqa: F401  (installs shims)
 from .version import __version__, id, version  # noqa: F401
 from .types import Diag, Layout, Norm, Op, Side, TileKind, Uplo  # noqa: F401
 from .options import (  # noqa: F401
-    GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm, MethodHemm,
-    MethodLU, MethodSvd, MethodTrsm, NormScope, Option, Target,
+    ErrorPolicy, GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm,
+    MethodHemm, MethodLU, MethodSvd, MethodTrsm, NormScope, Option, Target,
 )
 from .exceptions import (  # noqa: F401
     SlateError, SlateNotConvergedError, SlateNotPositiveDefiniteError,
-    SlateValueError,
+    SlateSingularError, SlateValueError,
 )
+from . import robust  # noqa: F401
+from .robust.health import HealthInfo  # noqa: F401
 from .core.grid import Grid, make_grid  # noqa: F401
 from .core.storage import TileStorage  # noqa: F401
 from .core.matrix import (  # noqa: F401

@@ -21,13 +21,16 @@ import jax.numpy as jnp
 from ..core.matrix import (BandMatrix, HermitianBandMatrix, Matrix,
                            TriangularBandMatrix)
 from ..core.storage import TileStorage
-from ..exceptions import SlateNotPositiveDefiniteError, slate_error
+from ..exceptions import (SlateNotPositiveDefiniteError, SlateSingularError,
+                          slate_error)
 from ..internal.band import (band_transpose, banded_trsm_lower,
                              banded_trsm_upper, dense_to_banded,
                              gbmm_banded, gbtrf_banded, gbtrs_banded,
                              hermitian_band_expand, pbtrf_banded,
                              pbtrs_banded)
-from ..options import Options
+from ..options import ErrorPolicy, Option, Options
+from ..robust import faults
+from ..robust import health as _health
 from ..types import Diag, Op, Side, Uplo
 from ..util.trace import annotate
 
@@ -106,32 +109,42 @@ def pbtrf(A: HermitianBandMatrix, opts: Options | None = None) -> PBFactors:
     slate_error(isinstance(A, HermitianBandMatrix),
                 "pbtrf: need HermitianBandMatrix")
     lp, kd = _hermitian_band_packed(A)
+    lp = faults.maybe_corrupt("input", lp)
     n = A.m
     w = _block_width(A.nb, kd)
     lband = pbtrf_banded(lp, kd, n, w)
-    # definiteness check: cholesky NaN-fills on failure.  Raise only when
-    # eager (a traced call stays jittable; failure then surfaces as NaNs,
-    # the XLA convention — same contract as potrf)
-    diag_ok = jnp.all(jnp.isfinite(lband[0]))
-    if not isinstance(diag_ok, jax.core.Tracer) and not bool(diag_ok):
-        raise SlateNotPositiveDefiniteError("pbtrf: not positive definite")
-    return PBFactors(lband, kd, n, w)
+    # definiteness shows up as NaN on the packed diagonal row (cholesky
+    # NaN-fills on failure); finalize keeps the historical contract — eager
+    # raise, traced NaN-flow — and adds the info/nan/policy variants
+    h = _health.merge(_health.from_pivots(lband[0]),
+                      _health.from_result(lband))
+    return _health.finalize(
+        "pbtrf", PBFactors(lband, kd, n, w), h, opts,
+        lambda hh: SlateNotPositiveDefiniteError(
+            f"pbtrf: not positive definite ({hh.describe()})",
+            info=int(hh.info)))
 
 
 @annotate("slate.pbtrs")
 def pbtrs(F: PBFactors, B, opts: Options | None = None):
     """Solve from pbtrf factors (ref: src/pbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
-    x = F.solve(b)
+    x = faults.maybe_corrupt("solve", F.solve(b))
     return _wrap_like(x, Bm, F.n)
 
 
 @annotate("slate.pbsv")
 def pbsv(A: HermitianBandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A Hermitian positive-definite band (ref: src/pbsv.cc).
-    Returns (PBFactors, X)."""
-    F = pbtrf(A, opts)
-    return F, pbtrs(F, B, opts)
+    Returns (PBFactors, X); ``(F, X, HealthInfo)`` under ErrorPolicy.Info."""
+    F, fh = pbtrf(A, _with_policy(opts, ErrorPolicy.Info))
+    X = pbtrs(F, B, opts)
+    h = _health.merge(fh, _health.from_result(_raw(X)))
+    return _finalize_band_solve(
+        "pbsv", F, X, h, opts,
+        lambda hh: SlateNotPositiveDefiniteError(
+            f"pbsv: not positive definite ({hh.describe()})",
+            info=int(hh.info)))
 
 
 # ------------------------------------------------------------- gb chain
@@ -152,25 +165,61 @@ def gbtrf(A: BandMatrix, opts: Options | None = None) -> GBFactors:
         kl, ku = ku, kl
     # working array with kl fill rows on top
     gp = jnp.zeros((2 * kl + ku + 1, n), gp0.dtype).at[kl:].set(gp0)
+    gp = faults.maybe_corrupt("input", gp)
     w = _block_width(A.nb, kl + ku)
+    amax = jnp.max(jnp.abs(gp))
     lu, perms = gbtrf_banded(gp, kl, ku, n, w)
-    return GBFactors(lu, perms, kl, ku, n, w)
+    # U's diagonal lives at packed row kl+ku; an exactly-zero or
+    # non-finite pivot is a singular factorization (eager calls raise
+    # SlateSingularError under the default policy)
+    growth = jnp.where(amax > 0, jnp.max(jnp.abs(lu)) / amax, jnp.inf)
+    h = _health.merge(_health.from_pivots(lu[kl + ku], growth=growth),
+                      _health.from_result(lu))
+    return _health.finalize(
+        "gbtrf", GBFactors(lu, perms, kl, ku, n, w), h, opts,
+        lambda hh: SlateSingularError(
+            f"gbtrf: exactly-singular or non-finite factor "
+            f"({hh.describe()})", info=int(hh.info)))
 
 
 @annotate("slate.gbtrs")
 def gbtrs(F: GBFactors, B, opts: Options | None = None):
     """Solve from gbtrf factors (ref: src/gbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
-    x = F.solve(b)
+    x = faults.maybe_corrupt("solve", F.solve(b))
     return _wrap_like(x, Bm, F.n)
 
 
 @annotate("slate.gbsv")
 def gbsv(A: BandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A general band (ref: src/gbsv.cc).
-    Returns (GBFactors, X)."""
-    F = gbtrf(A, opts)
-    return F, gbtrs(F, B, opts)
+    Returns (GBFactors, X); ``(F, X, HealthInfo)`` under ErrorPolicy.Info."""
+    F, fh = gbtrf(A, _with_policy(opts, ErrorPolicy.Info))
+    X = gbtrs(F, B, opts)
+    h = _health.merge(fh, _health.from_result(_raw(X)))
+    return _finalize_band_solve(
+        "gbsv", F, X, h, opts,
+        lambda hh: SlateSingularError(
+            f"gbsv: singular band matrix ({hh.describe()})",
+            info=int(hh.info)))
+
+
+def _with_policy(opts: Options | None, policy: ErrorPolicy) -> dict:
+    o = dict(opts or {})
+    o[Option.ErrorPolicy] = policy
+    return o
+
+
+def _raw(X):
+    return X.storage.data if isinstance(X, Matrix) else jnp.asarray(X)
+
+
+def _finalize_band_solve(name, F, X, h, opts, make_exc):
+    res = _health.finalize(name, (F, X), h, opts, make_exc)
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        (F, X), h = res
+        return F, X, h
+    return res
 
 
 # ------------------------------------------------------------- tbsm

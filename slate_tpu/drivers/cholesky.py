@@ -24,9 +24,11 @@ from jax import lax
 from ..core.matrix import (BaseTrapezoidMatrix, HermitianMatrix, Matrix,
                            SymmetricMatrix, TriangularMatrix)
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateNotPositiveDefiniteError, slate_error
 from ..options import Option, Options, Target, get_option, resolve_target
 from ..parallel.dist_chol import SUPERBLOCKS, dist_potrf, superblock
+from ..robust import faults
+from ..robust import health as _health
 from ..types import Diag, Op, Uplo
 from .blas3 import as_root_general, trsm
 from ..internal.potrf import potrf_tile
@@ -51,7 +53,7 @@ def _potrf_dense_blocked(a, nb: int):
         upd = a[k0:, k0:k1]
         if k0:
             upd = upd - a[k0:, :k0] @ jnp.conj(a[k0:k1, :k0]).T
-        lkk = potrf_tile(upd[:w])
+        lkk = faults.maybe_corrupt("post_panel", potrf_tile(upd[:w]))
         a = a.at[k0:k1, k0:k1].set(lkk)
         if k1 < n:
             linv = tri_inv_lower(lkk)
@@ -62,7 +64,13 @@ def _potrf_dense_blocked(a, nb: int):
 @annotate("slate.potrf")
 def potrf(A, opts: Options | None = None) -> TriangularMatrix:
     """Factor A = L L^H (Lower) or A = U^H U (Upper); returns the triangular
-    factor (ref: src/potrf.cc)."""
+    factor (ref: src/potrf.cc).
+
+    Failure contract (Option.ErrorPolicy, see docs/ROBUSTNESS.md): eager
+    calls raise :class:`SlateNotPositiveDefiniteError` when a leading minor
+    is not positive definite (a NaN/zero L diagonal); under ``info`` the
+    return is ``(L, HealthInfo)`` with the LAPACK-style 1-based index of
+    the first bad diagonal."""
     slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
                 "potrf: need HermitianMatrix/SymmetricMatrix")
     uplo = A._uplo_logical()
@@ -80,22 +88,56 @@ def potrf(A, opts: Options | None = None) -> TriangularMatrix:
         else:
             full = A.to_dense()
             st_l = TileStorage.from_dense(full, nb, nb, A.grid)
+        data_in = faults.maybe_corrupt("input", st_l.data)
         # Option.Lookahead scales the unrolled-superblock count: more
         # lookahead = more statically visible k steps for XLA to pipeline
         # across (the analog of the reference's lookahead task depth,
         # potrf.cc:266-287), at proportional compile-time cost
         la = max(1, int(get_option(opts, Option.Lookahead)))
-        out = dist_potrf(st_l.data, st_l.Nt, A.grid, n=st_l.n,
-                         sb=superblock(st_l.Nt, SUPERBLOCKS * la))
+        out, minpiv, minidx = dist_potrf(
+            data_in, st_l.Nt, A.grid, n=st_l.n,
+            sb=superblock(st_l.Nt, SUPERBLOCKS * la))
         st_out = TileStorage(out, st_l.m, st_l.n, nb, nb, A.grid)
         L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)
-        return L.conj_transpose() if uplo is Uplo.Upper else L
+        # finiteness over the WRITTEN (lower) triangle only — the kernel
+        # never touches strictly-upper tiles, which may hold stale input
+        h = _chol_health(jnp.tril(st_out.canonical()), minpiv, minidx)
+        return _finalize_potrf(L, h, uplo, opts)
 
-    full = A.to_dense()
+    full = faults.maybe_corrupt("input", A.to_dense())
     lfac = _potrf_dense_blocked(full, nb)
     st_out = TileStorage.from_dense(lfac, nb, nb, A.grid)
     L = TriangularMatrix._from_view(Matrix(st_out), Uplo.Lower)
-    return L.conj_transpose() if uplo is Uplo.Upper else L
+    d = jnp.abs(jnp.diagonal(lfac))
+    d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
+    minidx = jnp.argmin(d)
+    h = _chol_health(jnp.tril(lfac), d[minidx], minidx)
+    return _finalize_potrf(L, h, uplo, opts)
+
+
+def _chol_health(lower_arr, minpiv, minidx) -> "_health.HealthInfo":
+    """HealthInfo for a Cholesky factor: diagonal record + finiteness of
+    the written triangle.  Growth is left at 1.0 — unpivoted Cholesky of an
+    HPD matrix cannot exhibit element growth, so it carries no signal."""
+    h = _health.healthy(lower_arr.dtype)
+    bad = (minpiv == 0) | ~jnp.isfinite(minpiv)
+    return h._replace(
+        nonfinite=~jnp.all(jnp.isfinite(
+            jnp.abs(lower_arr) if jnp.iscomplexobj(lower_arr)
+            else lower_arr)),
+        info=jnp.where(bad, minidx.astype(jnp.int32) + 1, 0),
+        min_pivot=minpiv.astype(h.min_pivot.dtype),
+        min_pivot_index=minidx.astype(jnp.int32),
+    )
+
+
+def _finalize_potrf(L, h, uplo, opts):
+    Lv = L.conj_transpose() if uplo is Uplo.Upper else L
+    return _health.finalize(
+        "potrf", Lv, h, opts,
+        lambda hh: SlateNotPositiveDefiniteError(
+            f"potrf: leading minor not positive definite "
+            f"({hh.describe()})", info=int(hh.info)))
 
 
 @annotate("slate.potrs")
@@ -105,15 +147,23 @@ def potrs(L: TriangularMatrix, B, opts: Options | None = None) -> Matrix:
     slate_error(isinstance(L, BaseTrapezoidMatrix), "potrs: need factor")
     if L._uplo_logical() is Uplo.Lower:
         Y = trsm("l", 1.0, L, B, opts)
-        return trsm("l", 1.0, L.conj_transpose(), Y, opts)
-    Y = trsm("l", 1.0, L.conj_transpose(), B, opts)
-    return trsm("l", 1.0, L, Y, opts)
+        X = trsm("l", 1.0, L.conj_transpose(), Y, opts)
+    else:
+        Y = trsm("l", 1.0, L.conj_transpose(), B, opts)
+        X = trsm("l", 1.0, L, Y, opts)
+    if faults.active("solve") is not None:
+        sx = X.storage
+        X = Matrix(TileStorage(faults.maybe_corrupt("solve", sx.data),
+                               sx.m, sx.n, sx.mb, sx.nb, sx.grid))
+    return X
 
 
 @annotate("slate.posv")
 def posv(A, B, opts: Options | None = None):
     """Solve A X = B for Hermitian positive definite A
-    (ref: src/posv.cc).  Returns (L, X).
+    (ref: src/posv.cc).  Returns (L, X); with Option.UseFallbackSolver an
+    eager call on a non-HPD matrix falls back to hesv, then gesv — see
+    robust/recovery.py and docs/ROBUSTNESS.md.
 
     Option.HoldLocalWorkspace fuses factor+solve into ONE jitted program
     so the factor's workspace stays live on device between the phases —
@@ -127,9 +177,8 @@ def posv(A, B, opts: Options | None = None):
 
 
 def _posv_body(A, B, opts):
-    L = potrf(A, opts)
-    X = potrs(L, B, opts)
-    return L, X
+    from ..robust.recovery import posv_with_recovery
+    return posv_with_recovery(A, B, opts)
 
 
 @functools.lru_cache(maxsize=32)

@@ -23,11 +23,13 @@ from jax import lax
 
 from ..core.matrix import Matrix, TriangularMatrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateSingularError, slate_error
 from ..ops.elementwise import entry_mask
 from ..options import (MethodLU, Option, Options, Target, get_option,
                        resolve_target, select_lu_method)
 from ..parallel.dist_lu import dist_getrf
+from ..robust import faults
+from ..robust import health as _health
 from ..types import Diag, Op, Uplo
 from ..util.trace import annotate
 from .blas3 import as_root_general, trsm
@@ -95,6 +97,7 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
             lu, perm = panel_lu_threshold(pan, tau)
         else:
             lu, perm = panel_lu(pan)
+        lu = faults.maybe_corrupt("post_panel", lu)
         a = a.at[k0:, k0:k1].set(lu)
         if method != "nopiv":
             a = a.at[k0:, :k0].set(_apply_row_perm(a[k0:, :k0], perm, 2 * w))
@@ -112,7 +115,12 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
 
 @annotate("slate.getrf")
 def getrf(A: Matrix, opts: Options | None = None) -> LUFactors:
-    """LU with partial pivoting (ref: src/getrf.cc)."""
+    """LU with partial pivoting (ref: src/getrf.cc).
+
+    Failure contract (Option.ErrorPolicy, see docs/ROBUSTNESS.md): eager
+    calls raise :class:`SlateSingularError` on an exactly-zero or
+    non-finite pivot; under ``info`` the return is
+    ``(LUFactors, HealthInfo)``."""
     return _getrf(A, opts, "partial")
 
 
@@ -128,7 +136,23 @@ def getrf_tntpiv(A: Matrix, opts: Options | None = None) -> LUFactors:
     return _getrf(A, opts, "tntpiv")
 
 
-def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
+def _lu_health(factor_arr, minpiv, minidx, amax):
+    """Assemble the LU HealthInfo: pivot record from the panel min-pivot
+    trace + whole-factor finiteness + pivot-growth ratio."""
+    h = _health.healthy(factor_arr.dtype)
+    fmax = jnp.max(jnp.abs(factor_arr))
+    bad = (minpiv == 0) | ~jnp.isfinite(minpiv)
+    return h._replace(
+        nonfinite=~jnp.all(jnp.isfinite(factor_arr)),
+        info=jnp.where(bad, minidx.astype(jnp.int32) + 1, 0),
+        min_pivot=minpiv.astype(h.min_pivot.dtype),
+        min_pivot_index=minidx.astype(jnp.int32),
+        growth=jnp.where(amax > 0, fmax / amax,
+                         jnp.inf).astype(h.growth.dtype),
+    )
+
+
+def _getrf(A: Matrix, opts: Options | None, method: str):
     target = resolve_target(opts, A)
     nb = A.nb
     tau = float(get_option(opts, Option.PivotThreshold))
@@ -140,24 +164,42 @@ def _getrf(A: Matrix, opts: Options | None, method: str) -> LUFactors:
         slate_error(A.m == A.n, "mesh getrf: square matrices (gesv path)")
         An = as_root_general(A, nb, nb, grid=A.grid)
         st = An.storage
+        data_in = faults.maybe_corrupt("input", st.data)
+        amax = jnp.max(jnp.abs(data_in))
         la = max(1, int(get_option(opts, Option.Lookahead)))
-        data, perm = dist_getrf(st.data, st.Nt, A.grid, st.n, method,
-                                ib=get_option(opts, Option.InnerBlocking),
-                                sb=superblock(st.Nt, SUPERBLOCKS * la),
-                                tau=tau, mpt=mpt, depth=depth)
+        data, perm, minpiv, minidx = dist_getrf(
+            data_in, st.Nt, A.grid, st.n, method,
+            ib=get_option(opts, Option.InnerBlocking),
+            sb=superblock(st.Nt, SUPERBLOCKS * la),
+            tau=tau, mpt=mpt, depth=depth)
         out = TileStorage(data, st.m, st.n, nb, nb, st.grid)
         # restore the pad-region-zero invariant (final ragged panel is
         # identity-augmented inside the factorization)
         clean = out.canonical() * entry_mask(st.m, st.n, nb, nb).astype(
             out.dtype)
         out = out.with_canonical(clean)
-        return LUFactors(Matrix(out), perm[: st.m])
+        F = LUFactors(Matrix(out), perm[: st.m])
+        h = _lu_health(clean, minpiv, minidx, amax)
+        return _health.finalize(f"getrf[{method}]", F, h, opts,
+                                _singular(method))
 
-    ad = A.to_dense()
+    ad = faults.maybe_corrupt("input", A.to_dense())
+    amax = jnp.max(jnp.abs(ad))
     lu, perm = _getrf_dense_blocked(ad, nb, method, tau=tau, mpt=mpt,
                                     depth=depth)
     st = TileStorage.from_dense(lu, nb, nb, A.grid)
-    return LUFactors(Matrix(st), perm)
+    F = LUFactors(Matrix(st), perm)
+    udiag = jnp.abs(jnp.diagonal(lu))
+    minidx = jnp.argmin(udiag)
+    h = _lu_health(lu, udiag[minidx], minidx, amax)
+    return _health.finalize(f"getrf[{method}]", F, h, opts,
+                            _singular(method))
+
+
+def _singular(method: str):
+    return lambda h: SlateSingularError(
+        f"getrf[{method}]: exactly-singular or non-finite factor "
+        f"({h.describe()})", info=int(h.info))
 
 
 @annotate("slate.getrs")
@@ -179,28 +221,28 @@ def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
         bperm = B.to_dense()[F.perm]
         Bp = Matrix(TileStorage.from_dense(bperm, B.mb, B.nb, B.grid))
     Y = trsm("l", 1.0, F.lower(), Bp, opts)
-    return trsm("l", 1.0, F.upper(), Y, opts)
+    X = trsm("l", 1.0, F.upper(), Y, opts)
+    if faults.active("solve") is not None:
+        sx = X.storage
+        X = Matrix(TileStorage(faults.maybe_corrupt("solve", sx.data),
+                               sx.m, sx.n, sx.mb, sx.nb, sx.grid))
+    return X
 
 
 @annotate("slate.gesv")
 def gesv(A: Matrix, B, opts: Options | None = None):
     """Solve A X = B via LU (ref: src/gesv.cc; MethodLU dispatch).
-    Returns (LUFactors, X)."""
-    method = select_lu_method(opts)
-    if method is MethodLU.NoPiv:
-        F = getrf_nopiv(A, opts)
-    elif method is MethodLU.CALU:
-        F = getrf_tntpiv(A, opts)
-    else:
-        F = getrf(A, opts)
-    X = getrs(F, B, opts)
-    return F, X
+    Returns (LUFactors, X); with Option.UseFallbackSolver an eager call
+    escalates pivoting (NoPiv -> PartialPiv -> CALU) on unhealthy
+    factors — see robust/recovery.py and docs/ROBUSTNESS.md."""
+    from ..robust.recovery import gesv_with_recovery
+    return gesv_with_recovery(A, B, opts)
 
 
 def gesv_nopiv(A: Matrix, B, opts: Options | None = None):
-    """ref: src/gesv_nopiv.cc"""
-    F = getrf_nopiv(A, opts)
-    return F, getrs(F, B, opts)
+    """ref: src/gesv_nopiv.cc — no escalation: the raw NoPiv contract."""
+    from ..robust.recovery import gesv_nopiv_raw
+    return gesv_nopiv_raw(A, B, opts)
 
 
 @annotate("slate.getri")

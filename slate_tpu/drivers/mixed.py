@@ -35,7 +35,10 @@ from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
-from ..options import Option, Options, get_option
+from ..options import ErrorPolicy, Option, Options, get_option
+from ..robust import health as _health
+from ..robust.health import HealthInfo
+from ..robust.recovery import bounded_retry
 from ..types import Norm, eps, lower_precision
 from ..util.trace import annotate
 from . import auxiliary as aux
@@ -45,9 +48,24 @@ from .lu import getrf, getrs
 
 
 class MixedResult(NamedTuple):
+    """Mixed-precision solve result.  ``converged`` is the contract — a
+    mixed driver NEVER raises on mere non-convergence (the reference
+    returns its iter count the same way); ``health`` carries the full
+    HealthInfo of whichever attempt produced X."""
     X: Matrix
     iters: int
     converged: bool
+    health: HealthInfo | None = None
+
+
+def _info_opts(opts: Options | None) -> dict:
+    """Internal factor calls always run under ErrorPolicy.Info: the
+    low-precision factor is EXPECTED to fail on hard inputs (that is what
+    the refinement loop and fallback are for), so its health is data, not
+    an exception."""
+    o = dict(opts or {})
+    o[Option.ErrorPolicy] = ErrorPolicy.Info
+    return o
 
 
 def _cast_matrix(M, dt) -> Matrix:
@@ -96,14 +114,35 @@ def _refine(A: Matrix, B: Matrix, solve_lo, opts: Options | None):
     return x, it, conv
 
 
-def _maybe_fallback(ok, x, fallback):
-    """Full-precision fallback (ref: gesv_mixed_gmres.cc:58-77).  Traced
-    calls skip it (the converged flag is still reported)."""
-    if isinstance(ok, jax.core.Tracer):
-        return x, ok
-    if not bool(ok):
-        return fallback(), True
-    return x, True
+def _mixed_health(fh, x, it, ok) -> HealthInfo:
+    """Health of a refine attempt: low-precision factor record + final-x
+    finiteness; converged is the IR verdict."""
+    h = _health.merge(fh, _health.from_result(x.storage.data))
+    return h._replace(iters=jnp.asarray(it, jnp.int32),
+                      converged=jnp.asarray(ok))
+
+
+def _full_lu_attempt(A, B, opts):
+    """Full-precision fallback attempt (ref: gesv_mixed_gmres.cc:58-77)."""
+    F, fh = getrf(A, _info_opts(opts))
+    X = getrs(F, B, opts)
+    return X, _health.merge(fh, _health.from_result(X.storage.data))
+
+
+def _full_chol_attempt(A, B, opts):
+    L, fh = potrf(A, _info_opts(opts))
+    X = potrs(L, B, opts)
+    return X, _health.merge(fh, _health.from_result(X.storage.data))
+
+
+def _finish_mixed(x, it, h, fallback, opts):
+    """Route the optional full-precision fallback through the shared
+    bounded-retry policy (eager-only; traced calls report health as-is)."""
+    fallbacks = ([fallback] if get_option(opts, Option.UseFallbackSolver)
+                 else [])
+    x, h, used = bounded_retry((x, h), fallbacks, dtype=x.dtype,
+                               max_retries=1)
+    return MixedResult(x, it, h.ok, h)
 
 
 @annotate("slate.gesv_mixed")
@@ -112,16 +151,14 @@ def gesv_mixed(A: Matrix, B, opts: Options | None = None) -> MixedResult:
     (ref: src/gesv_mixed.cc)."""
     lo = lower_precision(A.dtype)
     Alo = _cast_matrix(A, lo)
-    F = getrf(Alo, opts)
+    F, fh = getrf(Alo, _info_opts(opts))
 
     def solve_lo(R):
         return _cast_matrix(getrs(F, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _refine(A, B, solve_lo, opts)
-    if get_option(opts, Option.UseFallbackSolver):
-        x, ok = _maybe_fallback(ok, x, lambda: getrs(getrf(A, opts), B,
-                                                     opts))
-    return MixedResult(x, it, ok)
+    return _finish_mixed(x, it, _mixed_health(fh, x, it, ok),
+                         lambda: _full_lu_attempt(A, B, opts), opts)
 
 
 @annotate("slate.posv_mixed")
@@ -130,16 +167,14 @@ def posv_mixed(A: HermitianMatrix, B, opts: Options | None = None
     """Cholesky in low precision + IR (ref: src/posv_mixed.cc)."""
     lo = lower_precision(A.dtype)
     Alo = HermitianMatrix._from_view(_cast_matrix(A, lo), A.uplo)
-    L = potrf(Alo, opts)
+    L, fh = potrf(Alo, _info_opts(opts))
 
     def solve_lo(R):
         return _cast_matrix(potrs(L, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _refine(A, B, solve_lo, opts)
-    if get_option(opts, Option.UseFallbackSolver):
-        x, ok = _maybe_fallback(ok, x, lambda: potrs(potrf(A, opts), B,
-                                                     opts))
-    return MixedResult(x, it, ok)
+    return _finish_mixed(x, it, _mixed_health(fh, x, it, ok),
+                         lambda: _full_chol_attempt(A, B, opts), opts)
 
 
 # ---------------------------------------------------------------- GMRES-IR
@@ -253,16 +288,14 @@ def gesv_mixed_gmres(A: Matrix, B, opts: Options | None = None
     """ref: src/gesv_mixed_gmres.cc"""
     lo = lower_precision(A.dtype)
     Alo = _cast_matrix(A, lo)
-    F = getrf(Alo, opts)
+    F, fh = getrf(Alo, _info_opts(opts))
 
     def solve_lo(R):
         return _cast_matrix(getrs(F, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _gmres_ir(A, B, solve_lo, opts)
-    if get_option(opts, Option.UseFallbackSolver):
-        x, ok = _maybe_fallback(ok, x, lambda: getrs(getrf(A, opts), B,
-                                                     opts))
-    return MixedResult(x, it, ok)
+    return _finish_mixed(x, it, _mixed_health(fh, x, it, ok),
+                         lambda: _full_lu_attempt(A, B, opts), opts)
 
 
 @annotate("slate.posv_mixed_gmres")
@@ -271,13 +304,11 @@ def posv_mixed_gmres(A: HermitianMatrix, B, opts: Options | None = None
     """ref: src/posv_mixed_gmres.cc"""
     lo = lower_precision(A.dtype)
     Alo = HermitianMatrix._from_view(_cast_matrix(A, lo), A.uplo)
-    L = potrf(Alo, opts)
+    L, fh = potrf(Alo, _info_opts(opts))
 
     def solve_lo(R):
         return _cast_matrix(potrs(L, _cast_matrix(R, lo), opts), A.dtype)
 
     x, it, ok = _gmres_ir(A, B, solve_lo, opts)
-    if get_option(opts, Option.UseFallbackSolver):
-        x, ok = _maybe_fallback(ok, x, lambda: potrs(potrf(A, opts), B,
-                                                     opts))
-    return MixedResult(x, it, ok)
+    return _finish_mixed(x, it, _mixed_health(fh, x, it, ok),
+                         lambda: _full_chol_attempt(A, B, opts), opts)

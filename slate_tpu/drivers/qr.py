@@ -27,11 +27,13 @@ from jax import lax
 
 from ..core.matrix import Matrix, TriangularMatrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateNotPositiveDefiniteError, slate_error
 from ..internal.qr import (apply_q_left, apply_q_right,
                            householder_panel_blocked)
-from ..options import (MethodGels, Options, Target,
-                       resolve_target, select_gels_method)
+from ..options import (ErrorPolicy, MethodGels, Option, Options, Target,
+                       get_option, resolve_target, select_gels_method)
+from ..robust import health as _health
+from ..robust.recovery import bounded_retry
 from ..types import Op, Side, Uplo, is_complex
 from ..util.trace import annotate
 from .blas3 import _dense_to_like, _side, gemm, herk, trsm
@@ -229,13 +231,13 @@ def unmlq(side, op, F: LQFactors, C, opts: Options | None = None) -> Matrix:
     return unmqr(side, "n" if conj_trans else "c", F.F, C, opts)
 
 
-def qr_multiply(F: QRFactors):
+def qr_multiply(F: QRFactors, opts: Options | None = None):
     """Materialise the thin Q (first min(m,n) columns) by applying Q to I."""
     mq = F.QR.m
     r = min(mq, F.QR.n)
     eye = jnp.eye(mq, r, dtype=F.QR.dtype)
     E = Matrix(TileStorage.from_dense(eye, F.QR.mb, F.QR.nb, F.QR.grid))
-    return unmqr(Side.Left, "n", F, E)
+    return unmqr(Side.Left, "n", F, E, opts)
 
 
 def _gram(A: Matrix, opts: Options | None):
@@ -260,29 +262,61 @@ def _gram(A: Matrix, opts: Options | None):
                     Uplo.Lower), opts)
 
 
+def _info_opts(opts: Options | None) -> dict:
+    o = dict(opts or {})
+    o[Option.ErrorPolicy] = ErrorPolicy.Info
+    return o
+
+
+def _gram_exc(name: str):
+    """Typed failure for the CholQR family: the Gram matrix A^H A failed
+    Cholesky, i.e. A is rank-deficient or cond(A)^2 overwhelmed the
+    working precision (CholQR squares the conditioning)."""
+    return lambda h: SlateNotPositiveDefiniteError(
+        f"{name}: Gram matrix A^H A not positive definite — A is "
+        f"rank-deficient or too ill-conditioned for CholQR "
+        f"({h.describe()})", info=int(h.info))
+
+
 @annotate("slate.cholqr")
 def cholqr(A: Matrix, opts: Options | None = None):
     """Cholesky QR: G = A^H A, R = chol(G)^H, Q = A R^-1
     (ref: src/cholqr.cc).  Composes herk/potrf/trsm so the mesh path is the
-    distributed one.  Returns (Q, R) with R upper triangular."""
+    distributed one.  Returns (Q, R) with R upper triangular.
+
+    Failure contract (docs/ROBUSTNESS.md): an eager call on a
+    rank-deficient A raises :class:`SlateNotPositiveDefiniteError` (the
+    Gram matrix fails Cholesky); under ``Option.ErrorPolicy = info`` the
+    return is ``((Q, R), HealthInfo)``."""
     slate_error(A.m >= A.n, "cholqr: need m >= n")
     G = _gram(A, opts)
-    L = potrf(G, opts)                       # G = L L^H
+    L, fh = potrf(G, _info_opts(opts))       # G = L L^H
     R = L.conj_transpose()                   # upper
     Q = trsm(Side.Right, 1.0, R, A, opts)    # Q = A R^-1
-    return Q, R
+    h = _health.merge(fh, _health.from_result(Q.storage.data))
+    return _health.finalize("cholqr", (Q, R), h, opts, _gram_exc("cholqr"))
+
+
+def _gels_cholqr_attempt(A: Matrix, B, opts: Options | None):
+    """One semi-normal-equations solve under ErrorPolicy.Info; health
+    merges the Gram factor's record with the solution's finiteness."""
+    L, fh = potrf(_gram(A, opts), _info_opts(opts))
+    Z = gemm(1.0, A.conj_transpose(), B, 0.0, None, opts)   # A^H b
+    Y = trsm(Side.Left, 1.0, L, Z, opts)
+    X = trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
+    return X, _health.merge(fh, _health.from_result(X.storage.data))
 
 
 @annotate("slate.gels_cholqr")
 def gels_cholqr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     """Least squares via the semi-normal equations R^H R x = A^H b with R
     from CholQR (ref: src/gels_cholqr.cc).  Mesh-distributed by
-    construction."""
+    construction.  Same failure contract as :func:`cholqr`; no fallback —
+    use :func:`gels` for the method-escalating entry point."""
     slate_error(A.m >= A.n, "gels_cholqr: need m >= n")
-    L = potrf(_gram(A, opts), opts)
-    Z = gemm(1.0, A.conj_transpose(), B, 0.0, None, opts)   # A^H b
-    Y = trsm(Side.Left, 1.0, L, Z, opts)
-    return trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
+    X, h = _gels_cholqr_attempt(A, B, opts)
+    return _health.finalize("gels_cholqr", X, h, opts,
+                            _gram_exc("gels_cholqr"))
 
 
 @annotate("slate.gels_qr")
@@ -300,6 +334,12 @@ def gels_qr(A: Matrix, B, opts: Options | None = None) -> Matrix:
     return X.with_dense(xd)
 
 
+def _gels_qr_attempt(A: Matrix, B, opts: Options | None):
+    """Householder-QR fallback attempt for gels' bounded retry."""
+    X = gels_qr(A, B, opts)
+    return X, _health.from_result(X.storage.data)
+
+
 @annotate("slate.gels")
 def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
     """Linear least squares / minimum-norm solve (ref: src/gels.cc:141):
@@ -307,12 +347,23 @@ def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
     m >= n: overdetermined min ||Ax - b||, QR or CholQR per MethodGels
     (auto: CholQR for tall-skinny, ref method.hh:236-275).
     m < n:  minimum-norm solution via LQ: x = Q^H L^-1 b.
+
+    With Option.UseFallbackSolver an eager CholQR attempt whose Gram
+    matrix fails Cholesky (rank-deficient / squared-conditioning) retries
+    once via Householder QR — the bounded_retry policy shared with
+    gesv/posv (robust/recovery.py, docs/ROBUSTNESS.md).
     """
     m, n = A.m, A.n
     if m >= n:
         meth = select_gels_method(opts, m, n)
         if meth is MethodGels.CholQR:
-            return gels_cholqr(A, B, opts)
+            X, h = _gels_cholqr_attempt(A, B, opts)
+            fallbacks = []
+            if get_option(opts, Option.UseFallbackSolver):
+                fallbacks = [lambda: _gels_qr_attempt(A, B, opts)]
+            X, h, _ = bounded_retry((X, h), fallbacks, dtype=A.dtype,
+                                    max_retries=1)
+            return _health.finalize("gels", X, h, opts, _gram_exc("gels"))
         return gels_qr(A, B, opts)
     # minimum norm: A = L Q (L m x m lower), x = Q^H (L^-1 b)
     F = gelqf(A, opts)

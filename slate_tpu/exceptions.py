@@ -33,6 +33,17 @@ class SlateNotPositiveDefiniteError(SlateError):
         self.info = info
 
 
+class SlateSingularError(SlateError):
+    """Factorization hit an exactly-zero (or non-finite) pivot.
+
+    ``info`` follows the LAPACK getrf convention: the 1-based index of the
+    first unusable pivot, 0 when the position is unknown."""
+
+    def __init__(self, msg: str, info: int = 0):
+        super().__init__(msg)
+        self.info = info
+
+
 def slate_error(cond: bool, msg: str = "error") -> None:
     """Raise SlateValueError unless ``cond`` (ref: Exception.hh slate_error)."""
     if not cond:

@@ -36,6 +36,26 @@ class Target(enum.Enum):
     Devices = "mesh"
 
 
+class ErrorPolicy(enum.Enum):
+    """Failure-surfacing contract for factor/solve drivers (robust/health.py).
+
+    Raise  eager calls raise the typed exception (SlateSingularError /
+           SlateNotPositiveDefiniteError); traced calls cannot raise, so
+           failures surface as non-finite values (the XLA convention).
+           This is the default — it unifies the eager-raise vs traced-NaN
+           contracts the drivers previously pinned ad hoc.
+    Nan    never raise, even eagerly; failed results are explicitly
+           NaN-poisoned (jit-safe, deterministic garbage-out signalling).
+    Info   never raise, never poison; the driver additionally returns a
+           jit-compatible ``HealthInfo`` pytree (non-finite flag, LAPACK
+           info code, min-pivot index/magnitude, growth, IR iterations).
+    """
+
+    Raise = "raise"
+    Nan = "nan"
+    Info = "info"
+
+
 class Option(enum.Enum):
     """Option keys (ref: enums.hh:69-101)."""
 
@@ -46,6 +66,7 @@ class Option(enum.Enum):
     MaxIterations = "max_iterations"
     Tolerance = "tolerance"
     Target = "target"
+    ErrorPolicy = "error_policy"
     UseFallbackSolver = "use_fallback_solver"
     PivotThreshold = "pivot_threshold"
     MethodGemm = "method_gemm"
@@ -162,6 +183,7 @@ _DEFAULTS = {
     Option.MaxIterations: 30,
     Option.Tolerance: None,
     Option.Target: Target.auto,
+    Option.ErrorPolicy: ErrorPolicy.Raise,
     Option.UseFallbackSolver: True,
     Option.PivotThreshold: 1.0,
     Option.MethodGemm: MethodGemm.Auto,
@@ -181,20 +203,36 @@ _DEFAULTS = {
 }
 
 
-def get_option(opts: Options | None, key: Option, default: Any = None) -> Any:
-    """Read one option with framework defaults (ref: types.hh:180-206)."""
+_UNSET = object()
+
+# options whose values have a canonical enum: string spellings are accepted
+# uniformly ({Option.Target: "mesh"}, {Option.ErrorPolicy: "info"}) and
+# coerced here so every consumer sees the enum.
+_ENUM_VALUED = {Option.Target: Target, Option.ErrorPolicy: ErrorPolicy}
+
+
+def get_option(opts: Options | None, key: Option,
+               default: Any = _UNSET) -> Any:
+    """Read one option with framework defaults (ref: types.hh:180-206).
+
+    An explicitly passed ``default`` wins over the framework default even
+    when it is None (a sentinel distinguishes "no default given" from
+    ``default=None``)."""
     if opts and key in opts:
-        return opts[key]
-    if default is not None:
-        return default
-    return _DEFAULTS.get(key)
+        val = opts[key]
+    elif default is not _UNSET:
+        val = default
+    else:
+        val = _DEFAULTS.get(key)
+    coerce = _ENUM_VALUED.get(key)
+    if coerce is not None and isinstance(val, str):
+        val = coerce(val)
+    return val
 
 
 def resolve_target(opts: Options | None, matrix) -> Target:
     """Target::auto resolution: mesh iff the matrix lives on a >1-device grid."""
     t = get_option(opts, Option.Target)
-    if isinstance(t, str):
-        t = Target(t)
     if t is not Target.auto:
         return t
     grid = getattr(matrix, "grid", None)

@@ -38,6 +38,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.herk import herk_panel_update
+from ..robust import faults
+from ..util.compat_jax import shard_map_unchecked
 from ..util.trace import span
 from ..internal.potrf import potrf_tile
 from ..internal.trsm import trsm_tile_batch
@@ -61,8 +63,15 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
     idx = jnp.arange(nb)
     gi_all = r + p * jnp.arange(mtl)              # global tile row per slot
     zi = jnp.zeros((), jnp.int32)
+    # health trace: smallest L diagonal seen and its global element row
+    # (replicated — valid for out_specs P(); the scan-carry replication
+    # checker cannot prove it, hence shard_map_unchecked in dist_potrf)
+    rdt = jnp.zeros((), dt).real.dtype
+    minpiv = jnp.asarray(jnp.inf, rdt)
+    minidx = jnp.zeros((), jnp.int32)
 
-    def step(k, a_loc):
+    def step(k, carry):
+        a_loc, minpiv, minidx = carry
         rk, ck = k % p, k % q
         kkr, kkc = k // p, k // q
         # valid extent of diagonal tile k (ragged last tile); pad diagonal
@@ -90,7 +99,20 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                 ddiag = jnp.real(ddiag).astype(dt)
             dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
             lkk_aug = potrf_tile(dtile + pad_eye)
+            lkk_aug = faults.maybe_corrupt("post_panel", lkk_aug)
             lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
+
+            # health trace: smallest L diagonal (replicated — every rank
+            # factored the same psum-gathered tile).  A non-HPD leading
+            # minor shows up as NaN on the diagonal, counted as a zero
+            # pivot; pad entries (idx >= vk) are excluded.
+            d = jnp.abs(jnp.diagonal(lkk_aug))
+            d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
+            d = jnp.where(idx < vk, d, jnp.full_like(d, jnp.inf))
+            j = jnp.argmin(d).astype(jnp.int32)
+            upd = d[j] < minpiv
+            minpiv = jnp.where(upd, d[j], minpiv)
+            minidx = jnp.where(upd, (k * nb + j).astype(jnp.int32), minidx)
 
             # -- panel trsm on the owner column's local tiles --
             pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
@@ -113,7 +135,7 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
             buf = buf.at[gi_all].set(contrib)
             buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
             gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)  # [p*mtl, nb, nb]
-        return a_loc, gpan
+        return (a_loc, minpiv, minidx), gpan
 
     for k0 in range(0, Nt, sb):
         k1 = min(k0 + sb, Nt)
@@ -122,8 +144,8 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
         S = mtl - (k0 // p)
         T = ntl - (k0 // q)
 
-        def super_step(k, a_loc, S=S, T=T):
-            a_loc, gpan = step(k, a_loc)
+        def super_step(k, carry, S=S, T=T):
+            (a_loc, minpiv, minidx), gpan = step(k, carry)
 
             def trailing(a_loc):
                 sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
@@ -142,14 +164,16 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                 return lax.dynamic_update_slice(a_loc, new,
                                                 (sr, sc, zi, zi))
 
-            return lax.cond(k < Nt - 1, trailing, lambda a: a, a_loc)
+            a_loc = lax.cond(k < Nt - 1, trailing, lambda a: a, a_loc)
+            return a_loc, minpiv, minidx
 
         if S <= 0 or T <= 0:
             # no rank has trailing tiles only when k0 >= Nt (cannot happen)
             continue
-        a_loc = lax.fori_loop(k0, k1, super_step, a_loc)
+        a_loc, minpiv, minidx = lax.fori_loop(
+            k0, k1, super_step, (a_loc, minpiv, minidx))
 
-    return a_loc
+    return a_loc, minpiv, minidx
 
 
 def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
@@ -157,14 +181,19 @@ def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
     """Factor the cyclic storage array of a Hermitian (lower) matrix in
     place: lower tiles of the result hold L.  ``n`` is the element dimension
     (for ragged last tiles); defaults to Nt*nb (exact tiling).  ``sb`` is
-    the inner fori_loop span (default: ~SUPERBLOCKS compiled bodies)."""
+    the inner fori_loop span (default: ~SUPERBLOCKS compiled bodies).
+
+    Returns ``(data, minpiv, minidx)``: the factored storage plus the
+    smallest L-diagonal magnitude seen and its global element row
+    (replicated scalars feeding drivers/cholesky.py's HealthInfo; a NaN
+    diagonal — non-HPD leading minor — is recorded as a zero pivot)."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     nb = data.shape[-1]
     n = n if n is not None else Nt * nb
     sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
-        mesh=grid.mesh, in_specs=(spec,), out_specs=spec)
+        mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P(), P()))
     return fn(data)

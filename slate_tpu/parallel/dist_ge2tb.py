@@ -35,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..util.compat_jax import shard_map_unchecked
 from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
 from .dist_he2hb import larfb_left_local, v_from_gathered
@@ -212,7 +213,7 @@ def dist_ge2tb(data, Mt: int, Ntn: int, m: int, n: int, grid: Grid,
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(max(Ntn, 1))
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a: _ge2tb_local(a, Mt, Ntn, m, n, grid.p, grid.q, mtl, ntl,
                                sb),
         mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P(), P()))
@@ -275,7 +276,7 @@ def dist_unmbr_ge2tb_u(a_data, Tqs, z_data, grid: Grid, m: int):
     """Apply the ge2tb U1 (QR chain) to mesh-distributed Z."""
     mtl = a_data.shape[0] // grid.p
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a, z, t: _unmbr_u_local(a, z, t, m, grid.p, grid.q, mtl),
         mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
     return fn(a_data, z_data, Tqs)
@@ -287,7 +288,7 @@ def dist_unmbr_ge2tb_v(a_data, Tls, z_data, grid: Grid, n: int):
     ntl = a_data.shape[1] // grid.q
     mtl_z = z_data.shape[0] // grid.p
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a, z, t: _unmbr_v_local(a, z, t, n, grid.p, grid.q,
                                        ntl, mtl_z),
         mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)

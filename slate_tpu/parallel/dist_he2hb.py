@@ -43,6 +43,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..util.compat_jax import shard_map_unchecked
 from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
 from ..util.trace import span
@@ -190,7 +191,7 @@ def dist_he2hb(data, Nt: int, grid: Grid, n: int | None = None,
     K = Nt - 1
     sb = sb if sb is not None else superblock(max(K, 1))
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a: _he2hb_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
         mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P()))
     return fn(data)
@@ -253,7 +254,7 @@ def dist_unmtr_he2hb(a_data, Ts, z_data, Nt: int, grid: Grid,
     nb = a_data.shape[-1]
     n = n if n is not None else Nt * nb
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a, z, t: _unmtr_local(a, z, t, Nt, n, grid.p, grid.q, mtl),
         mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
     return fn(a_data, z_data, Ts)

@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_from_col
 from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..util.compat_jax import pvary, shard_map_unchecked
 
 
 def _pair_budget(Mt: int, Nt: int, p: int, q: int, mtl: int, ntl: int,
@@ -118,8 +119,7 @@ def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
                 upd = jnp.asarray(alpha, dt) * pair_update(arow, acol)
             return acc + upd
 
-        acc0 = lax.pcast(jnp.zeros((S, nb, nb), dt), (AXIS_P, AXIS_Q),
-                         to="varying")
+        acc0 = pvary(jnp.zeros((S, nb, nb), dt), (AXIS_P, AXIS_Q))
         acc = lax.fori_loop(0, Kt, body, acc0)
         cflat = c_loc.reshape(mtl * ntl, nb, nb)
         # beta applies to the stored triangle only; other tiles unchanged
@@ -132,7 +132,7 @@ def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
 
     spec = P(AXIS_P, AXIS_Q, None, None)
     args = (a_data, c_data) + ((b_data,) if two_k else ())
-    fn = jax.shard_map(local, mesh=grid.mesh,
+    fn = shard_map_unchecked(local, mesh=grid.mesh,
                        in_specs=(spec,) * len(args), out_specs=spec)
     return fn(*args)
 
@@ -182,8 +182,8 @@ def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
         cb = b_loc.shape[-1]
         gi_all = r + p * jnp.arange(mtl)
         zi = jnp.zeros((), jnp.int32)
-        acc = lax.pcast(jnp.zeros((mtl, ntl, nb, cb), dt),
-                        (AXIS_P, AXIS_Q), to="varying")
+        acc = pvary(jnp.zeros((mtl, ntl, nb, cb), dt),
+                    (AXIS_P, AXIS_Q))
 
         def panel_k(k, a_loc, b_loc):
             # A tile column k -> all mesh columns (listBcast of the panel)
@@ -228,7 +228,7 @@ def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
         return jnp.asarray(alpha, dt) * acc
 
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, spec),
+    fn = shard_map_unchecked(local, mesh=grid.mesh, in_specs=(spec, spec),
                        out_specs=spec)
     return fn(a_data, b_data)
 
@@ -253,8 +253,8 @@ def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
         cb = b_loc.shape[-2]
         gj_all = c + q * jnp.arange(ntl)
         zi = jnp.zeros((), jnp.int32)
-        acc = lax.pcast(jnp.zeros((mtl, ntl, cb, nb), dt),
-                        (AXIS_P, AXIS_Q), to="varying")
+        acc = pvary(jnp.zeros((mtl, ntl, cb, nb), dt),
+                    (AXIS_P, AXIS_Q))
 
         def panel_k(k, a_loc, b_loc):
             # A tile row k -> all mesh rows
@@ -300,6 +300,6 @@ def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
         return jnp.asarray(alpha, dt) * acc
 
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, spec),
+    fn = shard_map_unchecked(local, mesh=grid.mesh, in_specs=(spec, spec),
                        out_specs=spec)
     return fn(a_data, b_data)

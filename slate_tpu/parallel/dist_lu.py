@@ -43,6 +43,8 @@ from jax.sharding import PartitionSpec as P
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
                               panel_lu_tournament)
+from ..robust import faults
+from ..util.compat_jax import shard_map_unchecked
 from .dist_chol import superblock
 
 
@@ -105,6 +107,13 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
     gi_all = r + p * jnp.arange(mtl)
     idx = jnp.arange(nb)
     zi = jnp.zeros((), jnp.int32)
+    # health trace: smallest |U diagonal| seen and its global element row.
+    # The panel is psum-replicated, so every rank tracks identical values
+    # (valid for out_specs P(); the scan-carry replication checker cannot
+    # prove it, hence shard_map_unchecked in dist_getrf).
+    rdt = jnp.zeros((), dt).real.dtype
+    minpiv = jnp.asarray(jnp.inf, rdt)
+    minidx = jnp.zeros((), jnp.int32)
 
     for k0 in range(0, Nt, sb):
         k1 = min(k0 + sb, Nt)
@@ -116,7 +125,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
 
         def super_step(k, carry, W0=W0, W=W, nbundle=nbundle, S=S, T=T,
                        k0=k0):
-            a_loc, perm_g = carry
+            a_loc, perm_g, minpiv, minidx = carry
             rk, ck = k % p, k % q
             kkr = k // p
             vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
@@ -145,7 +154,19 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 lu, perm = panel_lu_threshold(panel, tau)
             else:
                 lu, perm = panel_lu(panel)
+            lu = faults.maybe_corrupt("post_panel", lu)
             lut = lu.reshape(W0, nb, nb)
+
+            # ---- health trace: this step's U diagonal is diag(lut[0]);
+            #      NaN entries count as zero pivots, pad entries (ragged
+            #      final tile, idx >= vk) are excluded ----
+            d = jnp.abs(jnp.diagonal(lut[0]))
+            d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
+            d = jnp.where(idx < vk, d, jnp.full_like(d, jnp.inf))
+            j = jnp.argmin(d).astype(jnp.int32)
+            upd = d[j] < minpiv
+            minpiv = jnp.where(upd, d[j], minpiv)
+            minidx = jnp.where(upd, (k * nb + j).astype(jnp.int32), minidx)
 
             # ---- batched row exchange for ALL columns (left + right +
             #      panel; panel values rewritten below) ----
@@ -216,11 +237,12 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             if S > 0 and T > 0:
                 a_loc, perm_g = lax.cond(k < Nt - 1, tail,
                                          lambda cr: cr, (a_loc, perm_g))
-            return a_loc, perm_g
+            return a_loc, perm_g, minpiv, minidx
 
-        a_loc, perm_g = lax.fori_loop(k0, k1, super_step, (a_loc, perm_g))
+        a_loc, perm_g, minpiv, minidx = lax.fori_loop(
+            k0, k1, super_step, (a_loc, perm_g, minpiv, minidx))
 
-    return a_loc, perm_g[:m_pad]
+    return a_loc, perm_g[:m_pad], minpiv, minidx
 
 
 def dist_permute_rows(b_data, perm, grid: Grid):
@@ -272,8 +294,11 @@ def dist_permute_rows(b_data, perm, grid: Grid):
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
                ib: int = 16, sb: int | None = None, tau: float = 1.0,
                mpt: int = 4, depth: int = 2):
-    """Factor square cyclic storage in place; returns (data, perm) with
-    A[perm] = L @ U (perm over the padded row space, identity on pads).
+    """Factor square cyclic storage in place; returns
+    (data, perm, minpiv, minidx) with A[perm] = L @ U (perm over the
+    padded row space, identity on pads).  ``minpiv``/``minidx`` are the
+    smallest |U diagonal| encountered and its global element row —
+    replicated scalars feeding drivers/lu.py's HealthInfo.
 
     ``tau`` (Option.PivotThreshold) < 1 switches the partial-pivot panel to
     threshold pivoting; ``mpt`` (Option.MaxPanelThreads) sizes the CALU
@@ -282,9 +307,9 @@ def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
                                     method, ib, sb, tau, mpt, depth),
         mesh=grid.mesh, in_specs=(spec,),
-        out_specs=(spec, P()))
+        out_specs=(spec, P(), P(), P()))
     return fn(data)

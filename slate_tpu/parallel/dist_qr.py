@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_along
 from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..util.compat_jax import pvary, shard_map_unchecked
 from ..internal.qr import (build_t, householder_panel,
                            householder_panel_blocked, unit_lower)
 
@@ -133,13 +134,7 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
     # Initial carries must carry the same device-variance the loop body
     # produces: Tr varies over mesh rows (p) but is bcast along q; the tree
     # factors are psum-replicated everywhere (out_specs P() relies on it).
-    def _pvary(x, axes):
-        try:
-            return lax.pcast(x, axes, to="varying")
-        except (AttributeError, TypeError):
-            return lax.pvary(x, axes)
-
-    Tloc0 = _pvary(jnp.zeros((Kt, nb, nb), dt), (AXIS_P,))
+    Tloc0 = pvary(jnp.zeros((Kt, nb, nb), dt), (AXIS_P,))
     Vtree0 = jnp.zeros((Kt, p * nb, nb), dt)
     Ttree0 = jnp.zeros((Kt, nb, nb), dt)
 
@@ -208,7 +203,7 @@ def dist_geqrf_data(data, Kt, Mt, m, n, grid: Grid):
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a: _geqrf_local(a, Kt, Mt, m, n, grid.p, grid.q, mtl, ntl),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P(AXIS_P, None, None), P(), P()))
@@ -269,7 +264,7 @@ def dist_unmqr_data(a_data, c_data, Tloc, Vtree, Ttree, Kt, Mt, m,
     mtl = a_data.shape[0] // grid.p
     ntl_c = c_data.shape[1] // grid.q
     spec = P(AXIS_P, AXIS_Q, None, None)
-    fn = jax.shard_map(
+    fn = shard_map_unchecked(
         lambda a, cd, tl, vt, tt: _unmqr_local(
             a, cd, tl, vt, tt, Kt, Mt, m, grid.p, grid.q, mtl, ntl_c,
             conj_trans),

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.trsm import apply_op_tile
+from ..robust import faults
 from ..types import Op, Uplo
 from .dist_chol import superblock
 
@@ -76,6 +77,7 @@ def _trsm_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a, unit_diag,
             unit_diagonal=unit_diag))(brow)
         xk = jnp.where(r == rk, xk, jnp.zeros_like(xk))
         xk = lax.psum(xk, AXIS_P)                   # replicated down columns
+        xk = faults.maybe_corrupt("post_collective", xk)
         row_sel = jnp.where(r == rk, xk, brow)
         b_loc = lax.dynamic_update_slice(
             b_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
@@ -195,6 +197,7 @@ def _trsm_right_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a,
             unit_diagonal=unit_diag))(bcol)
         xk = jnp.where(c == ck, xk, jnp.zeros_like(xk))
         xk = lax.psum(xk, AXIS_Q)                   # replicated across rows
+        xk = faults.maybe_corrupt("post_collective", xk)
         col_sel = jnp.where(c == ck, xk, bcol)
         b_loc = lax.dynamic_update_slice(
             b_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ..comm.collectives import bcast_from_col, bcast_from_row
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.gemm import tile_outer_product
+from ..robust import faults
 
 
 def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int):
@@ -45,6 +46,7 @@ def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int):
         return acc + tile_outer_product(a_col, b_row)
 
     acc = lax.fori_loop(0, Kt, body, jnp.zeros_like(c_loc))
+    acc = faults.maybe_corrupt("post_collective", acc)
     return alpha * acc + beta * c_loc
 
 

@@ -1,0 +1,25 @@
+"""Numerical-health subsystem: jit-safe info codes, fault injection, and
+driver-level recovery/escalation.
+
+Three parts (see docs/ROBUSTNESS.md for the per-driver contract table):
+
+- :mod:`health`   — the ``HealthInfo`` pytree threaded through the factor
+  and solve drivers, plus the ``Option.ErrorPolicy`` resolution that
+  unifies the eager-raise vs traced-NaN contracts.
+- :mod:`faults`   — a deterministic, seeded fault injector that corrupts
+  named sites (input tiles, post-panel factors, post-collective results)
+  so detection and recovery paths are testable on CPU.
+- :mod:`recovery` — driver-level graceful degradation: LU pivoting
+  escalation (NoPiv -> PartialPiv -> CALU), posv -> hesv/gesv fallback on
+  non-HPD input, and the bounded-retry policy the mixed-precision
+  full-precision fallback routes through.
+"""
+
+from .health import (  # noqa: F401
+    HealthInfo, error_policy, finalize, from_pivots, from_result, healthy,
+    merge, poison,
+)
+from .faults import FaultPlan, inject, maybe_corrupt  # noqa: F401
+from .recovery import (  # noqa: F401
+    bounded_retry, gesv_with_recovery, posv_with_recovery,
+)

@@ -1,0 +1,116 @@
+"""Deterministic, seeded fault injection at named driver sites.
+
+Tile-level fault sensitivity is the production concern for long-running
+accelerator kernels ("Design in Tiles" / "Ragged Paged Attention",
+PAPERS.md): a single corrupted tile in a factorization propagates into a
+finite-but-wrong solution unless detection is explicit.  This module makes
+those faults reproducible on CPU so the detection and recovery paths in
+:mod:`health` / :mod:`recovery` are testable in tier-1.
+
+Sites are trace-time gates: :func:`maybe_corrupt` is a no-op (returns its
+input untouched, traces nothing) unless a plan for that site is active via
+the :func:`inject` context manager.  Because activation is decided when the
+computation is TRACED, jitted functions must be traced inside the context —
+a function compiled without faults will not retroactively corrupt.
+
+Named sites (see docs/ROBUSTNESS.md):
+
+=================  =====================================================
+``input``          driver inputs (A's tiles) before factorization
+``post_panel``     a just-factored panel, before the trailing update
+``post_collective`` a collective result (SUMMA accumulator, broadcast
+                   X row in the distributed trsm sweep)
+``solve``          the computed solution X
+=================  =====================================================
+
+Payloads: ``nan``, ``inf``, and ``bitflip`` — a high-exponent-bit flip
+(value scaled by 2^100), the silent-data-corruption payload that stays
+FINITE and is only caught by pivot-growth / residual checks.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+SITES = ("input", "post_panel", "post_collective", "solve")
+KINDS = ("nan", "inf", "bitflip")
+
+# flipping exponent bit 6 of an O(1) value: finite, wildly wrong
+_BITFLIP_SCALE = 2.0 ** 100
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One corruption: ``count`` elements of the first array that flows
+    through ``site``, positions drawn deterministically from ``seed``."""
+
+    site: str
+    kind: str = "nan"
+    seed: int = 0
+    count: int = 1
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(f"unknown fault site {self.site!r}; "
+                             f"sites: {SITES}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"kinds: {KINDS}")
+
+
+_ACTIVE: dict[str, FaultPlan] = {}
+
+
+@contextlib.contextmanager
+def inject(*plans: FaultPlan):
+    """Activate fault plans for the dynamic extent of the block.  Traced
+    computations pick up the corruption only if traced inside."""
+    saved = dict(_ACTIVE)
+    try:
+        for p in plans:
+            _ACTIVE[p.site] = p
+        yield
+    finally:
+        _ACTIVE.clear()
+        _ACTIVE.update(saved)
+
+
+def active(site: str) -> FaultPlan | None:
+    return _ACTIVE.get(site)
+
+
+def corrupt(x, plan: FaultPlan):
+    """Apply ``plan`` to array ``x`` (pure, jit-safe): deterministic flat
+    positions from the seed, payload per ``plan.kind``.
+
+    Positions are drawn with HOST numpy at trace time (seed, count and
+    x.size are all static), so the corruption lowers to constant-index
+    scatters — no jax.random traffic inside jit/shard_map, where this
+    jax's replication checker rejects the shuffle primitives."""
+    import numpy as np
+    x = jnp.asarray(x)
+    if x.size == 0 or not jnp.issubdtype(x.dtype, jnp.inexact):
+        return x
+    k = min(plan.count, x.size)
+    idx = jnp.asarray(np.random.default_rng(plan.seed).choice(
+        x.size, size=k, replace=False))
+    flat = x.reshape(-1)
+    if plan.kind == "nan":
+        flat = flat.at[idx].set(jnp.nan)
+    elif plan.kind == "inf":
+        flat = flat.at[idx].set(jnp.inf)
+    else:  # bitflip: exponent-bit flip — finite but wildly wrong
+        flat = flat.at[idx].multiply(_BITFLIP_SCALE)
+    return flat.reshape(x.shape)
+
+
+def maybe_corrupt(site: str, x):
+    """The site hook drivers call: identity unless a plan is active."""
+    plan = _ACTIVE.get(site)
+    if plan is None:
+        return x
+    return corrupt(x, plan)

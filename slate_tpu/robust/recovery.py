@@ -1,0 +1,187 @@
+"""Driver-level graceful degradation: escalate, fall back, retry — bounded.
+
+The reference has exactly one recovery path (gesv_mixed's full-precision
+fallback after itermax, ref: src/gesv_mixed.cc).  Production service wants
+the same shape everywhere a cheap method can fail on hard inputs:
+
+- :func:`gesv_with_recovery` — LU pivoting escalation
+  NoPiv -> PartialPiv -> CALU, keyed on non-finite factors, zero pivots,
+  or pivot growth beyond ``health.growth_limit`` (a NoPiv factor of a
+  row-scaled matrix, or a bit-flipped panel, explodes the growth ratio
+  long before the residual is ever formed).
+- :func:`posv_with_recovery` — non-HPD input falls back to the Aasen
+  ``hesv`` (Hermitian indefinite), then to plain ``gesv``, when
+  ``Option.UseFallbackSolver`` is set.
+- :func:`bounded_retry` — the shared policy: at most ``max_retries``
+  fallback attempts, eager-only (a traced call cannot branch on health;
+  it reports the HealthInfo instead), each attempt health-checked.
+
+Escalation requires host control flow, so it engages only on EAGER calls;
+traced calls run the requested method once and surface health per
+``Option.ErrorPolicy`` (docs/ROBUSTNESS.md has the full contract table).
+"""
+
+from __future__ import annotations
+
+from ..exceptions import (SlateNotPositiveDefiniteError, SlateSingularError)
+from ..options import (ErrorPolicy, MethodLU, Option, Options, get_option,
+                       select_lu_method)
+from . import health as _h
+
+
+def _with(opts: Options | None, **kv) -> dict:
+    o = dict(opts or {})
+    for name, v in kv.items():
+        o[Option[name]] = v
+    return o
+
+
+def bounded_retry(first, fallbacks, *, dtype, max_retries: int = 2):
+    """Run ``fallbacks`` (closures returning ``(result, HealthInfo)``) in
+    order until a health passes :func:`health.acceptable`, trying at most
+    ``max_retries`` of them.  ``first`` is the already-computed
+    ``(result, HealthInfo)`` of the primary attempt.  Traced health →
+    return ``first`` (no host branch exists under jit).
+    Returns ``(result, health, retries_used)``.
+
+    The returned health holds the same growth bound the retry loop selects
+    on: ``converged`` is demoted when growth exceeds
+    :func:`health.growth_limit`, so a finite-but-catastrophically-grown
+    final attempt (e.g. a silently bit-flipped panel — finite values,
+    ``info == 0``) can never read as ``h.ok`` from a recovering entry
+    point.  jit-safe — pure jnp ops on the health leaves."""
+
+    def demote(hh):
+        return hh._replace(
+            converged=hh.converged & (hh.growth <= _h.growth_limit(dtype)))
+
+    result, h = first
+    if h.is_traced():
+        return result, demote(h), 0
+    used = 0
+    for fb in fallbacks:
+        if bool(_h.acceptable(h, dtype)) or used >= max_retries:
+            break
+        result, h = fb()
+        used += 1
+    return result, demote(h), used
+
+
+# ------------------------------------------------------------------ gesv
+
+_LU_CHAIN = {
+    MethodLU.NoPiv: (MethodLU.NoPiv, MethodLU.PartialPiv, MethodLU.CALU),
+    MethodLU.PartialPiv: (MethodLU.PartialPiv, MethodLU.CALU),
+    MethodLU.CALU: (MethodLU.CALU,),
+}
+
+
+def _lu_attempt(A, B, opts, method):
+    """One factor+solve attempt under ErrorPolicy.Info; health merges the
+    factor's pivot record with the solution's finiteness."""
+    from ..drivers import lu as _lu
+    o = _with(opts, MethodLU=method, ErrorPolicy=ErrorPolicy.Info)
+    factor = {MethodLU.NoPiv: _lu.getrf_nopiv,
+              MethodLU.CALU: _lu.getrf_tntpiv}.get(method, _lu.getrf)
+    F, fh = factor(A, o)
+    X = _lu.getrs(F, B, o)
+    h = _h.merge(fh, _h.from_result(X.storage.data))
+    return (F, X), h
+
+
+def gesv_with_recovery(A, B, opts: Options | None = None):
+    """gesv body with pivoting escalation (drivers/lu.py delegates here).
+
+    Return shape matches gesv's ErrorPolicy contract: ``(F, X)`` under
+    Raise/Nan, ``(F, X, HealthInfo)`` under Info."""
+    method = select_lu_method(opts)
+    chain = _LU_CHAIN[method]
+    if not get_option(opts, Option.UseFallbackSolver):
+        chain = chain[:1]
+    (F, X), h = _lu_attempt(A, B, opts, chain[0])
+    # bounded_retry demotes `converged` on growth beyond the limit: the raw
+    # drivers keep growth out of .ok, the recovering solver does not.
+    (F, X), h, _ = bounded_retry(
+        ((F, X), h),
+        [lambda m=m: _lu_attempt(A, B, opts, m) for m in chain[1:]],
+        dtype=A.dtype, max_retries=len(chain))
+    return _finalize_solve("gesv", F, X, h, opts, _singular_exc("gesv"))
+
+
+def gesv_nopiv_raw(A, B, opts: Options | None = None):
+    """gesv_nopiv body: single NoPiv attempt, NO escalation and NO growth
+    demotion — the historical contract is that a finite (if catastrophic)
+    NoPiv solve returns rather than raises."""
+    (F, X), h = _lu_attempt(A, B, opts, MethodLU.NoPiv)
+    return _finalize_solve("gesv_nopiv", F, X, h, opts,
+                           _singular_exc("gesv_nopiv"))
+
+
+# ------------------------------------------------------------------ posv
+
+def posv_with_recovery(A, B, opts: Options | None = None):
+    """posv body with non-HPD fallback (drivers/cholesky.py delegates).
+
+    On an eager non-HPD failure with Option.UseFallbackSolver set, retries
+    the solve as Hermitian-indefinite (hesv), then as plain LU (gesv).
+    The first returned element is the factor object of whichever method
+    succeeded (TriangularMatrix / HEFactors / LUFactors)."""
+    from ..drivers import cholesky as _chol
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    L, fh = _chol.potrf(A, o)
+    X = _chol.potrs(L, B, o)
+    h = _h.merge(fh, _h.from_result(X.storage.data))
+    fallbacks = []
+    if get_option(opts, Option.UseFallbackSolver):
+        fallbacks = [lambda: _hesv_attempt(A, B, opts),
+                     lambda: _gesv_attempt(A, B, opts)]
+    (F, X), h, _ = bounded_retry(((L, X), h), fallbacks, dtype=A.dtype)
+    return _finalize_solve(
+        "posv", F, X, h, opts,
+        lambda hh: SlateNotPositiveDefiniteError(
+            f"posv: not positive definite and fallback failed "
+            f"({hh.describe()})", info=int(hh.info)))
+
+
+def _hesv_attempt(A, B, opts):
+    from ..drivers import hetrf as _he
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Raise)
+    try:
+        F, X = _he.hesv(A, B, o)
+    except Exception:  # noqa: BLE001 — a failed fallback is just unhealthy
+        return (None, None), _h.healthy(A.dtype)._replace(
+            converged=_false())
+    h = _h.from_result(X.storage.data)
+    return (F, X), h
+
+
+def _gesv_attempt(A, B, opts):
+    from ..core.matrix import Matrix
+    from ..core.storage import TileStorage
+    from ..drivers import lu as _lu
+    Ag = Matrix(TileStorage.from_dense(A.to_dense(), A.nb, A.nb, A.grid))
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    F, fh = _lu.getrf(Ag, o)
+    X = _lu.getrs(F, B, o)
+    return (F, X), _h.merge(fh, _h.from_result(X.storage.data))
+
+
+# ------------------------------------------------------------------ shared
+
+def _false():
+    import jax.numpy as jnp
+    return jnp.asarray(False)
+
+
+def _singular_exc(name):
+    return lambda h: SlateSingularError(
+        f"{name}: singular or numerically unusable factor "
+        f"({h.describe()})", info=int(h.info))
+
+
+def _finalize_solve(name, F, X, h, opts, make_exc):
+    res = _h.finalize(name, (F, X), h, opts, make_exc)
+    if _h.error_policy(opts) is ErrorPolicy.Info:
+        (F, X), h = res
+        return F, X, h
+    return res

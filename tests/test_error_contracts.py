@@ -1,0 +1,15 @@
+"""Tier-1 shim for tools/check_error_contracts.py: the static assertion
+that every public factor/solve driver accepts ``opts`` and routes failures
+through the robust layer (docs/ROBUSTNESS.md)."""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+
+import check_error_contracts  # noqa: E402
+
+
+def test_error_contracts_hold():
+    assert check_error_contracts.check() == []

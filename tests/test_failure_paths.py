@@ -1,5 +1,7 @@
-"""Failure-path contracts (VERDICT r3 item 10): singular gbtrf, non-HPD
-pbtrf/potrf eager vs traced, and non-converged mixed without fallback."""
+"""Failure-path contracts (VERDICT r3 item 10 + the robustness tentpole):
+singular gbtrf/getrf eager vs traced, ErrorPolicy routing, fault-injected
+SUMMA/mesh-LU, escalation and fallback recovery, and non-converged mixed
+without fallback.  docs/ROBUSTNESS.md holds the full contract table."""
 
 import jax
 import jax.numpy as jnp
@@ -7,25 +9,272 @@ import numpy as np
 import pytest
 
 import slate_tpu as st
-from slate_tpu.exceptions import SlateNotPositiveDefiniteError
-from slate_tpu.options import Option
+from slate_tpu.exceptions import (SlateNotPositiveDefiniteError,
+                                  SlateSingularError)
+from slate_tpu.options import ErrorPolicy, MethodLU, Option, get_option
+from slate_tpu.robust import faults
 
 
-def test_gbtrf_singular_produces_nonfinite(rng):
-    # exactly singular band matrix: the unpivoted-across-blocks window LU
-    # hits a zero pivot; the documented contract is LAPACK-style garbage-in
-    # signalling — non-finite values in the factors/solve, never a wrong
-    # finite answer
-    n, kl, ku, mb = 12, 2, 2, 4
+def _singular_band(rng, n=12, kl=2, ku=2):
     a = np.triu(np.tril(rng.standard_normal((n, n)), kl), -ku)
     a[:, 3] = 0.0
     a[3, :] = 0.0                       # row+col zero => singular
-    A = st.BandMatrix.from_numpy(a, kl, ku, mb)
+    return a
+
+
+def _singular_square(rng, n=16):
+    # zero row+column: the pivot column at step 5 stays EXACTLY zero
+    # through the elimination updates (a duplicated column only gets
+    # there up to rounding, ~eps — which is LAPACK-healthy, info=0)
+    a = rng.standard_normal((n, n))
+    a[:, 5] = 0.0
+    a[5, :] = 0.0
+    return a
+
+
+# ---------------------------------------------------------------- band LU
+
+def test_gbtrf_singular_eager_raises(rng):
+    # exactly singular band matrix: the eager contract is a typed error
+    # with the LAPACK-style 1-based index of the first zero pivot — never
+    # a silently-wrong finite answer and never raw NaN garbage
+    n, kl, ku, mb = 12, 2, 2, 4
+    A = st.BandMatrix.from_numpy(_singular_band(rng), kl, ku, mb)
+    with pytest.raises(SlateSingularError) as ei:
+        st.gbtrf(A)
+    assert ei.value.info >= 1
+
+
+def test_gbtrf_singular_traced_nonfinite(rng):
+    # under jit the check cannot raise: the factor (and any solve through
+    # it) is NaN-poisoned instead
+    n, kl, ku, mb = 12, 2, 2, 4
+    A = st.BandMatrix.from_numpy(_singular_band(rng), kl, ku, mb)
     B = st.Matrix.from_numpy(rng.standard_normal((n, 1)), mb, mb)
-    F = st.gbtrf(A)
-    X = st.gbtrs(F, B)
+
+    @jax.jit
+    def solve(A, B):
+        return st.gbtrs(st.gbtrf(A), B)
+
+    X = solve(A, B)
     assert not np.all(np.isfinite(X.to_numpy()))
 
+
+def test_gbtrf_singular_info_policy(rng):
+    F, h = st.gbtrf(st.BandMatrix.from_numpy(_singular_band(rng), 2, 2, 4),
+                    {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+    assert int(h.info) >= 1
+
+
+# --------------------------------------------------------------- dense LU
+
+def test_getrf_singular_eager_raises(rng):
+    A = st.Matrix.from_numpy(_singular_square(rng), 8)
+    with pytest.raises(SlateSingularError) as ei:
+        st.getrf(A)
+    assert ei.value.info >= 1
+
+
+def test_getrf_singular_traced_contracts(rng):
+    # a pivoted LU of an exactly-singular matrix stays FINITE (zero U
+    # diagonal, the LAPACK convention) — the traced signal is the info
+    # code, and any solve through the factor goes non-finite
+    A = st.Matrix.from_numpy(_singular_square(rng), 8)
+    B = st.Matrix.from_numpy(np.ones((16, 1)), 8, 8)
+
+    @jax.jit
+    def factor_info(A):
+        F, h = st.getrf(A, {Option.ErrorPolicy: ErrorPolicy.Info})
+        return h
+
+    h = factor_info(A)
+    assert int(h.info) == 6
+    assert float(h.min_pivot) == 0.0
+
+    @jax.jit
+    def solve(A, B):
+        return st.gesv(A, B, {Option.UseFallbackSolver: False})[1].to_dense()
+
+    assert not bool(jnp.all(jnp.isfinite(solve(A, B))))
+
+
+def test_getrf_singular_info_string_spelling(rng):
+    # enum-valued options accept their string spellings
+    A = st.Matrix.from_numpy(_singular_square(rng), 8)
+    F, h = st.getrf(A, {Option.ErrorPolicy: "info"})
+    assert not bool(h.ok)
+    assert int(h.info) >= 1
+    assert float(h.min_pivot) == 0.0
+
+
+def test_gesv_singular_nan_policy_never_raises(rng):
+    n = 16
+    A = st.Matrix.from_numpy(_singular_square(rng, n), 8)
+    B = st.Matrix.from_numpy(rng.standard_normal((n, 2)), 8, 8)
+    F, X = st.gesv(A, B, {Option.ErrorPolicy: "nan"})
+    assert not np.all(np.isfinite(X.to_numpy()))
+
+
+# ------------------------------------------------------------- escalation
+
+def _nopiv_hostile(rng, n=16):
+    """Well-conditioned but with a zero leading entry: NoPiv divides by
+    zero on step one, PartialPiv sails through."""
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    a[0, 0] = 0.0
+    return a
+
+
+def test_gesv_escalation_recovers_eager(rng):
+    n = 16
+    a = _nopiv_hostile(rng, n)
+    b = rng.standard_normal((n, 2))
+    A = st.Matrix.from_numpy(a, 8)
+    B = st.Matrix.from_numpy(b, 8, 8)
+    F, X = st.gesv(A, B, {Option.MethodLU: MethodLU.NoPiv,
+                          Option.UseFallbackSolver: True})
+    assert np.allclose(X.to_numpy(), np.linalg.solve(a, b), atol=1e-8)
+
+
+def test_gesv_escalation_traced_reports_health(rng):
+    # a traced call cannot branch on health, so it runs NoPiv once and
+    # reports the failure through HealthInfo instead of escalating
+    n = 16
+    A = st.Matrix.from_numpy(_nopiv_hostile(rng, n), 8)
+    B = st.Matrix.from_numpy(rng.standard_normal((n, 1)), 8, 8)
+
+    @jax.jit
+    def solve(A, B):
+        F, X, h = st.gesv(A, B, {Option.MethodLU: MethodLU.NoPiv,
+                                 Option.UseFallbackSolver: True,
+                                 Option.ErrorPolicy: ErrorPolicy.Info})
+        return X.to_dense(), h
+
+    xd, h = solve(A, B)
+    assert not bool(h.ok)
+
+
+def test_posv_fallback_to_indefinite(rng):
+    n, nb = 16, 8
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2 - n * np.eye(n)   # symmetric negative definite
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    with pytest.raises(SlateNotPositiveDefiniteError):
+        st.posv(A, B, {Option.UseFallbackSolver: False})
+    F, X = st.posv(A, B, {Option.UseFallbackSolver: True})
+    assert np.allclose(X.to_numpy(), np.linalg.solve(a, b), atol=1e-8)
+
+
+def test_gels_cholqr_fallback_to_qr(rng):
+    # f32 with cond(A) ~ 1e6: the Gram squares that past 1/eps_f32 so
+    # CholQR's Cholesky fails, while plain Householder QR is fine — the
+    # exact regime the method fallback exists for
+    m, n = 24, 8
+    from slate_tpu.options import MethodGels
+    U, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    V, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    a = ((U * np.logspace(0, -6, n)) @ V.T).astype(np.float32)
+    b = rng.standard_normal((m, 1)).astype(np.float32)
+    A = st.Matrix.from_numpy(a, 8)
+    B = st.Matrix.from_numpy(b, 8, 8)
+    opts = {Option.MethodGels: MethodGels.CholQR}
+    with pytest.raises(SlateNotPositiveDefiniteError):
+        st.gels(A, B, {**opts, Option.UseFallbackSolver: False})
+    X = st.gels(A, B, {**opts, Option.UseFallbackSolver: True})
+    xd = np.asarray(X.to_dense(), np.float64)
+    x_ref, *_ = np.linalg.lstsq(a.astype(np.float64),
+                                b.astype(np.float64), rcond=None)
+    r = np.linalg.norm(a @ xd - b) / np.linalg.norm(a @ x_ref - b)
+    assert np.all(np.isfinite(xd)) and r < 1.01
+
+
+# -------------------------------------------------------- fault injection
+
+def test_fault_injector_deterministic():
+    x = jnp.ones((6, 6))
+    plan = faults.FaultPlan(site="input", kind="nan", seed=7, count=3)
+    y1, y2 = faults.corrupt(x, plan), faults.corrupt(x, plan)
+    assert int(jnp.sum(jnp.isnan(y1))) == 3
+    assert bool(jnp.all(jnp.isnan(y1) == jnp.isnan(y2)))
+
+
+def test_fault_injected_summa_mesh(rng):
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = rng.standard_normal((16, 16))
+    b = rng.standard_normal((16, 16))
+    A = st.Matrix.from_numpy(a, 4, 4, g)
+    B = st.Matrix.from_numpy(b, 4, 4, g)
+    with faults.inject(faults.FaultPlan(site="post_collective", kind="nan",
+                                        seed=1, count=2)):
+        out = st.gemm(1.0, A, B, 0.0, None)
+    assert not np.all(np.isfinite(out.to_numpy()))
+    # and the same call with no plan active is clean
+    out2 = st.gemm(1.0, A, B, 0.0, None)
+    assert np.allclose(out2.to_numpy(), a @ b, atol=1e-10)
+
+
+def test_mesh_getrf_fault_reports_health(rng):
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    n = 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    A = st.Matrix.from_numpy(a, 4, 4, g)
+    with faults.inject(faults.FaultPlan(site="post_panel", kind="nan",
+                                        seed=2, count=1)):
+        F, h = st.getrf(A, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+    # clean rerun is healthy and matches the single-device factor
+    F2, h2 = st.getrf(A, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert bool(h2.ok)
+
+
+def test_fault_injected_gesv_recovers_or_reports(rng):
+    # acceptance gate: with a fault at the panel site, gesv either returns
+    # a correct recovered answer or reports ill-health — never a silently
+    # wrong finite X
+    n = 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 1))
+    A = st.Matrix.from_numpy(a, 8)
+    B = st.Matrix.from_numpy(b, 8, 8)
+    with faults.inject(faults.FaultPlan(site="post_panel", kind="bitflip",
+                                        seed=3, count=1)):
+        out = st.gesv(A, B, {Option.ErrorPolicy: ErrorPolicy.Info,
+                             Option.UseFallbackSolver: True})
+    F, X, h = out
+    xd = X.to_numpy()
+    good = np.allclose(xd, np.linalg.solve(a, b), atol=1e-6)
+    assert good or not bool(h.ok)
+
+
+def test_fault_injected_gesv_mixed_never_silently_wrong(rng):
+    # a bit-flipped panel leaves the factor finite with info == 0; the only
+    # signal is pivot growth.  The fallback's factor is corrupted too (the
+    # fault context is still active), so bounded_retry must demote
+    # `converged` on growth rather than trust the fallback's .ok
+    n = 16
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 1))
+    A = st.Matrix.from_numpy(a, 8)
+    B = st.Matrix.from_numpy(b, 8, 8)
+    with faults.inject(faults.FaultPlan(site="post_panel", kind="bitflip",
+                                        seed=9, count=2)):
+        res = st.gesv_mixed(A, B)
+    xd = np.asarray(res.X.to_dense())
+    good = np.allclose(xd, np.linalg.solve(a, b), atol=1e-6)
+    assert good or not bool(res.converged)
+
+
+# ----------------------------------------------------------- option plumbing
+
+def test_get_option_explicit_none_default():
+    assert get_option(None, Option.MaxIterations, None) is None
+    assert get_option(None, Option.MaxIterations) is not None
+
+
+# ------------------------------------------------- band Cholesky (historic)
 
 def test_pbtrf_not_hpd_eager_raises(rng):
     n, kd, mb = 10, 2, 5

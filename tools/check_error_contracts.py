@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Static check: every public factor/solve driver honors the robustness
+contract (docs/ROBUSTNESS.md).
+
+Two assertions, enforced by AST inspection (no imports, no jax, runs
+anywhere):
+
+1. every public driver function in the checked modules accepts an ``opts``
+   parameter — Option.ErrorPolicy must be routable to every entry point;
+2. every checked module routes failures through the robust layer — it
+   imports from ``slate_tpu.robust`` (health / faults / recovery) at
+   module level or inside a function body.
+
+Runnable as a main (exit 1 + report on violation) and as pytest via
+tests/test_error_contracts.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DRIVERS = REPO / "slate_tpu" / "drivers"
+
+# the factor/solve surface: modules whose failures are numerical
+CHECKED_MODULES = ("lu.py", "cholesky.py", "band.py", "mixed.py", "qr.py")
+
+# public callables that are not drivers (constructors, helpers) or whose
+# contract predates opts (factor-object methods)
+EXEMPT = {
+    "tree_flatten", "tree_unflatten", "lower", "upper",
+}
+
+
+def _public_functions(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+            yield node
+
+
+def _accepts_opts(fn: ast.FunctionDef) -> bool:
+    names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+    return "opts" in names or fn.args.kwarg is not None
+
+
+def _imports_robust(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mod = node.module
+            if "robust" in mod.split("."):
+                return True
+            if mod.endswith("robust") or ".robust." in f".{mod}.":
+                return True
+        if isinstance(node, ast.Import):
+            if any("robust" in alias.name.split(".")
+                   for alias in node.names):
+                return True
+    return False
+
+
+def check() -> list[str]:
+    problems = []
+    for name in CHECKED_MODULES:
+        path = DRIVERS / name
+        if not path.exists():
+            problems.append(f"{name}: missing driver module")
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        if not _imports_robust(tree):
+            problems.append(
+                f"{name}: does not import the robust layer "
+                f"(health/faults/recovery) — failures are not routed "
+                f"through Option.ErrorPolicy")
+        for fn in _public_functions(tree):
+            if fn.name in EXEMPT:
+                continue
+            if not _accepts_opts(fn):
+                problems.append(
+                    f"{name}:{fn.lineno}: public driver `{fn.name}` "
+                    f"does not accept `opts` — Option.ErrorPolicy cannot "
+                    f"reach it")
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    if problems:
+        print("error-contract violations:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"error contracts OK across {len(CHECKED_MODULES)} driver modules")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
