@@ -192,7 +192,14 @@ def _fused_posv(opts_items):
 @annotate("slate.potri")
 def potri(L: TriangularMatrix, opts: Options | None = None):
     """Inverse from Cholesky factor: A^{-1} = L^-H L^-1
-    (ref: src/potri.cc = trtri + trtrm).  Returns a HermitianMatrix."""
+    (ref: src/potri.cc = trtri + trtrm).  Returns a HermitianMatrix;
+    under ``ErrorPolicy.Info`` returns ``(Ainv, HealthInfo)`` with the
+    two stage healths merged."""
+    from ..options import ErrorPolicy
     from .inverse import trtri, trtrm
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        Linv, h1 = trtri(L, opts)
+        C, h2 = trtrm(Linv, opts)
+        return C, _health.merge(h1, h2)
     Linv = trtri(L, opts)
     return trtrm(Linv, opts)

@@ -7,6 +7,16 @@ src/gecondest.cc:1-197, src/trcondest.cc, src/internal/internal_norm1est.cc:
 estimator is ONE lax.while_loop over (solve, solve^H) pairs — each solve is
 a pair of blocked triangular solves, so the whole estimate jits into a
 single XLA program.
+
+Failure contract: a singular factor poisons the appliers (NaN/Inf flow
+through the triangular solves), and NaN compares False everywhere — an
+unguarded xLACN2 loop then returns a NaN estimate AND corrupts its own
+convergence logic (``argmax`` of an all-NaN vector, a ``done`` flag that
+never sets).  The loop state here carries an explicit ``bad`` flag checked
+on every applier output; ``gecondest``/``trcondest`` resolve a poisoned
+estimate to ``rcond = 0`` ("singular as far as the estimate is concerned",
+the LAPACK convention) — never NaN — and report ``nonfinite=True`` through
+``HealthInfo`` under ``ErrorPolicy.Info``.
 """
 
 from __future__ import annotations
@@ -17,23 +27,27 @@ from jax import lax
 from ..core.matrix import TriangularMatrix
 from ..exceptions import slate_error
 from ..internal.qr import phase_of
-from ..options import Options
+from ..options import ErrorPolicy, Options
+from ..robust import health as _health
 from ..types import Norm, Uplo
 
 
-def norm1est(apply_inv, apply_inv_h, n: int, dtype, itmax: int = 5):
-    """Estimate ||A^-1||_1 given y = A^-1 x and z = A^-H x appliers
-    (Hager/Higham, ref internal_norm1est.cc / LAPACK xLACN2).
-
-    Runs as a lax.while_loop; jittable.  Returns a scalar estimate."""
+def _norm1est_flag(apply_inv, apply_inv_h, n: int, dtype, itmax: int = 5):
+    """Guarded Hager/Higham body: returns ``(est, bad)`` where ``bad``
+    flags any non-finite applier output.  Once bad, the loop freezes its
+    state and exits (NaN would otherwise sail through every comparison
+    with ``done`` never setting)."""
     rdt = jnp.zeros((), dtype).real.dtype
 
     def body(state):
-        x, est_old, jprev, k, done = state
+        x, est_old, jprev, k, done, bad = state
         y = apply_inv(x)
+        y_ok = jnp.all(jnp.isfinite(jnp.abs(y)))
         est = jnp.sum(jnp.abs(y))
         xi = phase_of(y)
         z = apply_inv_h(xi)
+        z_ok = jnp.all(jnp.isfinite(jnp.abs(z)))
+        newly_bad = ~(y_ok & z_ok)
         j = jnp.argmax(jnp.abs(z))
         # convergence: repeated index or no growth in the dual norm
         zj = jnp.abs(z)[j]
@@ -41,23 +55,51 @@ def norm1est(apply_inv, apply_inv_h, n: int, dtype, itmax: int = 5):
         stop = (zj <= ztx) | (j == jprev) | (est <= est_old)
         x_new = jnp.zeros((n,), dtype).at[j].set(1)
         est_out = jnp.maximum(est, est_old)
-        return (jnp.where(done, x, x_new), jnp.where(done, est_old, est_out),
-                jnp.where(done, jprev, j), k + 1, done | stop)
+        freeze = done | newly_bad
+        return (jnp.where(freeze, x, x_new),
+                jnp.where(freeze, est_old, est_out),
+                jnp.where(freeze, jprev, j), k + 1,
+                done | stop | newly_bad, bad | newly_bad)
 
     def cond(state):
-        _, _, _, k, done = state
+        _, _, _, k, done, _ = state
         return (k < itmax) & jnp.logical_not(done)
 
     x0 = jnp.full((n,), 1.0 / n, dtype)
     state = (x0, jnp.zeros((), rdt), jnp.asarray(-1), jnp.asarray(0),
-             jnp.asarray(False))
-    _, est, _, _, _ = lax.while_loop(cond, body, state)
+             jnp.asarray(False), jnp.asarray(False))
+    _, est, _, _, _, bad = lax.while_loop(cond, body, state)
 
     # alternating-magnitude safeguard vector (LAPACK xLACN2 final stage)
     i = jnp.arange(n)
     v = ((-1.0) ** i * (1.0 + i / max(n - 1, 1))).astype(dtype)
     est2 = 2.0 * jnp.sum(jnp.abs(apply_inv(v))) / (3.0 * n)
-    return jnp.maximum(est, est2)
+    bad = bad | ~jnp.isfinite(est2)
+    est = jnp.maximum(est, jnp.where(jnp.isfinite(est2), est2, 0.0))
+    return est, bad
+
+
+def norm1est(apply_inv, apply_inv_h, n: int, dtype, itmax: int = 5):
+    """Estimate ||A^-1||_1 given y = A^-1 x and z = A^-H x appliers
+    (Hager/Higham, ref internal_norm1est.cc / LAPACK xLACN2).
+
+    Runs as a lax.while_loop; jittable.  Returns a scalar estimate —
+    ``+inf`` (not NaN) when the appliers produce non-finite values, i.e.
+    the factor is singular as far as the estimate is concerned."""
+    est, bad = _norm1est_flag(apply_inv, apply_inv_h, n, dtype, itmax)
+    return jnp.where(bad, jnp.asarray(jnp.inf, est.dtype), est)
+
+
+def _condest_result(name, rcond, bad, dtype, opts):
+    """Shared policy resolution for the condition estimators: rcond = 0
+    IS the failure resolution (never a raise, never NaN — matching
+    LAPACK, whose xxCON quietly returns rcond = 0 for a singular factor);
+    Info additionally returns the HealthInfo with ``nonfinite`` set."""
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        h = _health.healthy(dtype)._replace(
+            nonfinite=bad, converged=jnp.logical_not(bad))
+        return rcond, h
+    return rcond
 
 
 def gecondest(F, anorm, opts: Options | None = None, norm: Norm = Norm.One):
@@ -66,7 +108,9 @@ def gecondest(F, anorm, opts: Options | None = None, norm: Norm = Norm.One):
 
     ``F`` is an LUFactors; ``anorm`` the 1-norm of the original A (compute
     with st.norm(Norm.One, A) before factoring, as the reference's tester
-    does)."""
+    does).  A singular/non-finite factor returns ``rcond = 0`` — never
+    NaN; under ``ErrorPolicy.Info``, ``(rcond, HealthInfo)`` with
+    ``nonfinite=True`` flagging the poisoned estimate."""
     slate_error(norm in (Norm.One, Norm.Inf), "gecondest: One or Inf norm")
     lu = F.LU.to_dense()
     n = lu.shape[0]
@@ -94,17 +138,21 @@ def gecondest(F, anorm, opts: Options | None = None, norm: Norm = Norm.One):
     if norm is Norm.Inf:
         # ||A^-1||_inf = ||A^-H||_1: swap the appliers
         apply_inv, apply_inv_h = apply_inv_h, apply_inv
-    ainv = norm1est(apply_inv, apply_inv_h, n, lu.dtype)
+    ainv, bad = _norm1est_flag(apply_inv, apply_inv_h, n, lu.dtype)
     anorm = jnp.asarray(anorm)
-    safe = (anorm > 0) & (ainv > 0)
-    return jnp.where(safe, 1.0 / jnp.where(safe, anorm * ainv, 1.0),
-                     jnp.zeros(()))
+    bad = bad | ~jnp.isfinite(anorm)
+    safe = (anorm > 0) & (ainv > 0) & ~bad
+    rcond = jnp.where(safe, 1.0 / jnp.where(safe, anorm * ainv, 1.0),
+                      jnp.zeros(()))
+    return _condest_result("gecondest", rcond, bad, lu.dtype, opts)
 
 
 def trcondest(R, opts: Options | None = None, norm: Norm = Norm.One):
     """Reciprocal condition estimate of a triangular matrix (ref:
     src/trcondest.cc — used on QR's R factor for least-squares
-    conditioning).  rcond = 1 / (||R||_1 * est(||R^-1||_1))."""
+    conditioning).  rcond = 1 / (||R||_1 * est(||R^-1||_1)).  A singular/
+    non-finite R returns ``rcond = 0`` — never NaN; under
+    ``ErrorPolicy.Info``, ``(rcond, HealthInfo)``."""
     slate_error(isinstance(R, TriangularMatrix), "trcondest: triangular")
     slate_error(norm in (Norm.One, Norm.Inf), "trcondest: One or Inf norm")
     rd = R.to_dense()
@@ -125,9 +173,11 @@ def trcondest(R, opts: Options | None = None, norm: Norm = Norm.One):
 
     a1, a2 = (apply_inv, apply_inv_h) if norm is Norm.One else (
         apply_inv_h, apply_inv)
-    rinv = norm1est(a1, a2, n, rd.dtype)
+    rinv, bad = _norm1est_flag(a1, a2, n, rd.dtype)
     rnorm = jnp.max(jnp.sum(jnp.abs(rd), axis=0)) if norm is Norm.One \
         else jnp.max(jnp.sum(jnp.abs(rd), axis=1))
-    safe = (rnorm > 0) & (rinv > 0)
-    return jnp.where(safe, 1.0 / jnp.where(safe, rnorm * rinv, 1.0),
-                     jnp.zeros(()))
+    bad = bad | ~jnp.isfinite(rnorm)
+    safe = (rnorm > 0) & (rinv > 0) & ~bad
+    rcond = jnp.where(safe, 1.0 / jnp.where(safe, rnorm * rinv, 1.0),
+                      jnp.zeros(()))
+    return _condest_result("trcondest", rcond, bad, rd.dtype, opts)

@@ -31,13 +31,22 @@ from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateNotConvergedError, slate_error
 from ..internal.qr import (householder_panel_blocked, householder_vec,
                            phase_of, unit_lower)
-from ..options import (MethodEig, Option, Options, Target, get_option,
-                       resolve_target)
+from ..options import (ErrorPolicy, MethodEig, Option, Options, Target,
+                       get_option, resolve_target)
+from ..robust import certify as _certify
+from ..robust import faults as _faults
+from ..robust import health as _health
 from ..types import Op, Uplo, is_complex
 from ..util.trace import annotate
+
+
+def _notconv_exc(name):
+    return lambda h: SlateNotConvergedError(
+        f"{name}: eigensolve failed certification ({h.describe()})",
+        iters=int(h.iters))
 
 
 # ---------------------------------------------------------------- stage 1
@@ -237,21 +246,29 @@ def _tridiag_eig(d, e, want_z: bool, opts: Options | None = None,
     steqr2 analog)."""
     meth = get_option(opts, Option.MethodEig)
     if meth is MethodEig.DC and want_z and d.shape[0] > 1:
-        from .stedc import stedc
-        return stedc(d, e, grid)
+        from .stedc import stedc_info
+        # certify=False: heev certifies its own (w, Z) against A at the
+        # driver boundary; only the secular/deflation flags are needed here
+        (w, z), h = stedc_info(d, e, grid, certify=False)
+        return w, z, h
     n = d.shape[0]
     T = (jnp.diag(d) + jnp.diag(e, -1) + jnp.diag(e, 1)
          if n > 1 else jnp.diag(d))
     if want_z:
-        return jnp.linalg.eigh(T)
-    return jnp.linalg.eigvalsh(T), None
+        w, z = jnp.linalg.eigh(T)
+        return w, z, _health.from_result(w)
+    w = jnp.linalg.eigvalsh(T)
+    return w, None, _health.from_result(w)
 
 
 def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None,
                 grid=None):
     """Stage 2 + tridiagonal seam, method-dispatched (the MethodEig
-    consumer).  Returns (w, Z2) with band = Z2 diag(w) Z2^H (Z2 None when
-    jobz=False).
+    consumer).  Returns (w, Z2, HealthInfo) with band = Z2 diag(w) Z2^H
+    (Z2 None when jobz=False); the fault sites ``post_stage1`` (the band
+    handed to stage 2) and ``post_chase`` (the chased tridiagonal) fire
+    here, and the health ANDs in the tridiagonal kernel's flags (stedc's
+    secular/deflation guards on the DC route).
 
     Auto: eigendecompose the band DIRECTLY with XLA's eigh — measured
     ~60x faster than the chase at n=4096 on TPU (the chase's ~n^2/(2 kd)
@@ -261,53 +278,64 @@ def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None,
     steqr2/stedc, which DOES pay).
     QR/DC: the parity route — hb2st bulge chase to a true tridiagonal,
     then the (d, e) kernel."""
+    band = _faults.maybe_corrupt("post_stage1", band)
     meth = get_option(opts, Option.MethodEig)
     if meth is MethodEig.Auto:
         if jobz:
             w, Z2 = jnp.linalg.eigh(band)
-            return w, Z2
-        return jnp.linalg.eigvalsh(band), None
+            return w, Z2, _health.from_result(w)
+        w = jnp.linalg.eigvalsh(band)
+        return w, None, _health.from_result(w)
     d, e, Q2 = _hb2st(band, nb, want_q=jobz)
-    w, ztri = _tridiag_eig(d, e, jobz, opts, grid)
+    d = _faults.maybe_corrupt("post_chase", d)
+    w, ztri, h = _tridiag_eig(d, e, jobz, opts, grid)
+    h = _health.merge(h, _health.from_result(d), _health.from_result(e))
     if not jobz:
-        return w, None
-    return w, Q2 @ ztri.astype(Q2.dtype)
+        return w, None, h
+    return w, Q2 @ ztri.astype(Q2.dtype), h
 
 
-def sterf(d, e):
+def sterf(d, e, opts: Options | None = None):
     """Eigenvalues of a real symmetric tridiagonal (d, e) — no vectors
-    (ref: src/sterf.cc wrapping LAPACK sterf)."""
-    return _tridiag_eig(jnp.asarray(d), jnp.asarray(e), False)[0]
+    (ref: src/sterf.cc wrapping LAPACK sterf).  Under ``ErrorPolicy.Info``
+    returns ``(w, HealthInfo)``."""
+    w, _, h = _tridiag_eig(jnp.asarray(d), jnp.asarray(e), False, opts)
+    return _health.finalize("sterf", w, h, opts, _notconv_exc("sterf"))
 
 
-def steqr(d, e):
+def steqr(d, e, opts: Options | None = None):
     """Eigendecomposition of a real symmetric tridiagonal (d, e)
     (ref: src/steqr2.cc QR iteration with distributed Z rows — here the
-    vendor eigh seam).  Returns (w, Z)."""
-    return _tridiag_eig(jnp.asarray(d), jnp.asarray(e), True)
+    vendor eigh seam).  Returns (w, Z); under ``ErrorPolicy.Info``,
+    ``(w, Z, HealthInfo)``."""
+    w, z, h = _tridiag_eig(jnp.asarray(d), jnp.asarray(e), True, opts)
+    return _health.finalize_flat("steqr", (w, z), h, opts,
+                                 _notconv_exc("steqr"))
 
 
 @annotate("slate.hb2st")
-def hb2st(HB, *, want_q: bool = True):
+def hb2st(HB, opts: Options | None = None, *, want_q: bool = True):
     """Band -> tridiagonal bulge chase as a public driver
     (ref: src/hb2st.cc): takes a HermitianBandMatrix, returns (d, e, Q2)
-    with band = Q2 T Q2^H."""
+    with band = Q2 T Q2^H; under ``ErrorPolicy.Info``,
+    ``(d, e, Q2, HealthInfo)``."""
     from ..core.matrix import HermitianBandMatrix
     slate_error(isinstance(HB, HermitianBandMatrix), "hb2st: need "
                 "HermitianBandMatrix")
-    return _hb2st(HB.to_dense(), HB.kd, want_q=want_q)
+    d, e, Q2 = _hb2st(HB.to_dense(), HB.kd, want_q=want_q)
+    h = _health.merge(_health.from_result(d), _health.from_result(e))
+    return _health.finalize_flat("hb2st", (d, e, Q2), h, opts,
+                                 _notconv_exc("hb2st"))
 
 
-@annotate("slate.heev")
-def heev(A, opts: Options | None = None, *, jobz: bool = True):
-    """Eigendecomposition A = Z diag(w) Z^H for Hermitian/symmetric A
-    (ref: src/heev.cc).  Returns (w, Z) — Z is None when jobz=False.
-
-    On a mesh, stage 1 (he2hb — all the O(n^3) flops) runs distributed
-    (_heev_mesh -> parallel/dist_he2hb); only the O(n nb) band is gathered
-    for the stage-2 bulge chase, exactly the reference's he2hbGather seam
-    (heev.cc:109-111).
-    """
+def heev_info(A, opts: Options | None = None, *, jobz: bool = True):
+    """heev compute body: ``((w, Zm), HealthInfo)``, no policy resolution
+    (the recovery layer escalates on this seam).  The health merges the
+    stage-2/tridiagonal flags with the a-posteriori eigen-certificate of
+    the back-transformed pairs against the ORIGINAL A
+    (``certify.certify_eig`` — so corruption anywhere in the two-stage
+    pipeline, including a silent bit-flip, fails the residual or
+    orthogonality check)."""
     slate_error(isinstance(A, (HermitianMatrix, SymmetricMatrix)),
                 "heev: need HermitianMatrix/SymmetricMatrix")
     # complex-symmetric (non-Hermitian) has no real eigendecomposition of
@@ -319,18 +347,47 @@ def heev(A, opts: Options | None = None, *, jobz: bool = True):
     n = A.m
     nb = A.nb
     if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
-        return _heev_mesh(A, opts, jobz)
-    ad = A.to_dense()
-    Vs, Ts, Ds, Ss = _he2hb_scan(ad, nb)
-    band = _band_from_stacks(Ds, Ss, n, nb)
-    w, Z2 = _stage2_eig(band, nb, jobz, opts)
-    if not jobz:
-        return w, None
-    N = Ds.shape[0] * nb
-    Zpad = jnp.zeros((N, n), Z2.dtype).at[:n].set(Z2)
-    Z = _unmtr_he2hb_stack(Vs, Ts, nb, Zpad)[:n]
-    Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
-    return w, Zm
+        w, Zm, h = _heev_mesh(A, opts, jobz)
+    else:
+        ad = A.to_dense()
+        Vs, Ts, Ds, Ss = _he2hb_scan(ad, nb)
+        band = _band_from_stacks(Ds, Ss, n, nb)
+        w, Z2, h = _stage2_eig(band, nb, jobz, opts)
+        if jobz:
+            N = Ds.shape[0] * nb
+            Zpad = jnp.zeros((N, n), Z2.dtype).at[:n].set(Z2)
+            Z = _unmtr_he2hb_stack(Vs, Ts, nb, Zpad)[:n]
+            Z = _faults.maybe_corrupt("post_backtransform", Z)
+            Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
+        else:
+            Zm = None
+    if jobz:
+        h = _health.merge(
+            _certify.certify_eig(A.to_dense(), w, Zm.to_dense()), h)
+    else:
+        h = _health.merge(_health.from_result(w), h)
+    return (w, Zm), h
+
+
+@annotate("slate.heev")
+def heev(A, opts: Options | None = None, *, jobz: bool = True):
+    """Eigendecomposition A = Z diag(w) Z^H for Hermitian/symmetric A
+    (ref: src/heev.cc).  Returns (w, Z) — Z is None when jobz=False;
+    under ``ErrorPolicy.Info``, ``(w, Z, HealthInfo)``.
+
+    Every result is a-posteriori certified (residual + orthogonality,
+    robust/certify.py); an eager certification failure escalates
+    MethodEig Auto -> DC -> QR (ScaLAPACK's D&C -> QR ladder) before the
+    ErrorPolicy resolves — see ``recovery.heev_with_recovery`` and
+    docs/ROBUSTNESS.md.
+
+    On a mesh, stage 1 (he2hb — all the O(n^3) flops) runs distributed
+    (_heev_mesh -> parallel/dist_he2hb); only the O(n nb) band is gathered
+    for the stage-2 bulge chase, exactly the reference's he2hbGather seam
+    (heev.cc:109-111).
+    """
+    from ..robust.recovery import heev_with_recovery
+    return heev_with_recovery(A, opts, jobz=jobz)
 
 
 def _heev_mesh(A, opts, jobz: bool):
@@ -364,13 +421,15 @@ def _heev_mesh(A, opts, jobz: bool):
     # route's merge gemms are row-distributed over this grid's mesh
     # (drivers/stedc.py _merge_gemm), the rest of stage 2 is single-node
     # by design, as the reference's is
-    w, Z2 = _stage2_eig(band, nb, jobz, opts, grid)
+    w, Z2, h = _stage2_eig(band, nb, jobz, opts, grid)
     if not jobz:
-        return w, None
+        return w, None, h
     Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))
     z_data = dist_unmtr_he2hb(data, Ts, Z0.storage.data, st_in.Nt, grid, n=n)
+    z_data = _faults.maybe_corrupt("post_backtransform", z_data)
     zs = Z0.storage
-    return w, Matrix(TileStorage(z_data, zs.m, zs.n, zs.mb, zs.nb, zs.grid))
+    return (w, Matrix(TileStorage(z_data, zs.m, zs.n, zs.mb, zs.nb,
+                                  zs.grid)), h)
 
 
 def heevd(A, opts: Options | None = None):
@@ -382,8 +441,13 @@ def heevd(A, opts: Options | None = None):
 
 def heev_vals(A, opts: Options | None = None):
     """Eigenvalues only (ref: heev with Job::NoVec; simplified_api
-    eig_vals).  Values-only twin of svd_vals."""
-    return heev(A, opts, jobz=False)[0]
+    eig_vals).  Values-only twin of svd_vals.  Under ``ErrorPolicy.Info``
+    returns ``(w, HealthInfo)``."""
+    res = heev(A, opts, jobz=False)
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        w, _, h = res
+        return w, h
+    return res[0]
 
 
 def hegst(A, L, opts: Options | None = None, *, itype: int = 1):
@@ -415,17 +479,30 @@ def hegv(A, B, opts: Options | None = None, *, jobz: bool = True,
     itype 2: A B x = w x   -> C = L^H A L,     x = L^-H z
     itype 3: B A x = w x   -> C = L^H A L,     x = L z
 
-    B = L L^H (Cholesky); returns (w, X) with X None when jobz=False."""
+    B = L L^H (Cholesky); returns (w, X) with X None when jobz=False;
+    under ``ErrorPolicy.Info``, ``(w, X, HealthInfo)`` merging the
+    Cholesky and eigensolve healths."""
     from .blas3 import trmm, trsm
     from .cholesky import potrf
     slate_error(itype in (1, 2, 3), "hegv: itype must be 1, 2, or 3")
-    L = potrf(B, opts)
+    info = _health.error_policy(opts) is ErrorPolicy.Info
+    if info:
+        L, h_chol = potrf(B, opts)
+    else:
+        L = potrf(B, opts)                       # Raise/Nan resolve inside
     C = hegst(A, L, opts, itype=itype)
-    w, Z = heev(C, opts, jobz=jobz)
+    res = heev(C, opts, jobz=jobz)
+    if info:
+        w, Z, h_eig = res
+        h = _health.merge(h_chol, h_eig)
+    else:
+        w, Z = res
     if not jobz:
-        return w, None
+        return (w, None, h) if info else (w, None)
     if itype == 3:
         X = trmm("l", 1.0, L, Z, opts)
     else:
         X = trsm("l", 1.0, L.conj_transpose(), Z, opts)
+    if info:
+        return w, X, _health.merge(h, _health.from_result(X.storage.data))
     return w, X

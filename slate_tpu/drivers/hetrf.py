@@ -28,10 +28,13 @@ from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix, SymmetricMatrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateSingularError, slate_error
 from ..internal.band import gbtrf_banded, gbtrs_banded
 from ..internal.getrf import panel_lu
 from ..options import Options
+from ..robust import certify as _certify
+from ..robust import faults as _faults
+from ..robust import health as _health
 from ..types import is_complex
 from ..util.trace import annotate
 
@@ -165,10 +168,37 @@ def _aasen_blocked(a, nb: int, constrain=None):
     return L[:n0, :n0], Tdiag, Tsub, piv[:n0]
 
 
+def _hetrf_health(A, F: HEFactors) -> _health.HealthInfo:
+    """Health of an Aasen factorization: (a) the band-T pivot record —
+    T's band LU has no pivoting escape beyond its band, so a zero/
+    non-finite U diagonal (row kl+ku = 2 kd of the packed factor,
+    internal/band.py layout) means a singular T and a poisoned solve,
+    reported LAPACK-style through ``info`` — and (b) the a-posteriori
+    LDLT certificate of P A P^H = L T L^H against the original matrix
+    (``certify.certify_ldlt``), which catches corruption the pivot
+    record cannot (a bit-flipped L is finite with healthy-looking T)."""
+    n0 = F.n
+    kd = min(F.nb, max(n0 - 1, 0))
+    udiag = F.Tlu[2 * kd, :n0]
+    cert = _certify.certify_ldlt(A.to_dense(), F.L, F.T_dense(), F.piv)
+    return _health.merge(_health.from_pivots(udiag), cert,
+                         _health.from_result(F.L))
+
+
+def _hetrf_exc(h):
+    return SlateSingularError(
+        f"hetrf: singular band T — Aasen's tridiagonal factor has a "
+        f"zero/non-finite pivot ({h.describe()})", info=int(h.info))
+
+
 @annotate("slate.hetrf")
-def hetrf(A, opts: Options | None = None) -> HEFactors:
+def hetrf(A, opts: Options | None = None):
     """Blocked Aasen factorization of a Hermitian indefinite matrix
     (ref: src/hetrf.cc).  Returns HEFactors; T has bandwidth A.nb.
+    Under ``ErrorPolicy.Info`` returns ``(HEFactors, HealthInfo)``; a
+    singular band T raises ``SlateSingularError(info=k)`` eagerly under
+    the default Raise policy (LAPACK's hetrf info contract — previously
+    ``gbtrf_banded`` emitted non-finite values with no signal).
 
     The recurrence amplifies matmul rounding, so the factorization pins
     true-f32 multiplication (TPU's default bf16-pass matmul loses the
@@ -181,10 +211,15 @@ def hetrf(A, opts: Options | None = None) -> HEFactors:
     from ..options import Target, resolve_target
     nb = A.nb
     if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
-        return _hetrf_mesh(A, nb)
+        F = _hetrf_mesh(A, nb)
+    else:
+        with jax.default_matmul_precision("highest"):
+            L, Tdiag, Tsub, piv = _aasen_blocked(A.to_dense(), nb)
+            L = _faults.maybe_corrupt("post_stage1", L)
+            F = _finish_factors(L, Tdiag, Tsub, piv, nb)
     with jax.default_matmul_precision("highest"):
-        L, Tdiag, Tsub, piv = _aasen_blocked(A.to_dense(), nb)
-        return _finish_factors(L, Tdiag, Tsub, piv, nb)
+        h = _hetrf_health(A, F)
+    return _health.finalize("hetrf", F, h, opts, _hetrf_exc)
 
 
 def _hetrf_mesh(A, nb: int) -> HEFactors:
@@ -276,6 +311,7 @@ def hetrs(F: HEFactors, B, opts: Options | None = None):
                                          transpose_a=True, conjugate_a=True,
                                          unit_diagonal=True)
         x = jnp.zeros_like(wv).at[F.piv].set(wv)
+    x = _faults.maybe_corrupt("solve", x)
     if isinstance(B, Matrix):
         return Matrix(TileStorage.from_dense(x, B.mb, B.nb, B.grid))
     return x
@@ -284,6 +320,12 @@ def hetrs(F: HEFactors, B, opts: Options | None = None):
 @annotate("slate.hesv")
 def hesv(A, B, opts: Options | None = None):
     """Solve A X = B for Hermitian indefinite A (ref: src/hesv.cc).
-    Returns (HEFactors, X)."""
-    F = hetrf(A, opts)
-    return F, hetrs(F, B, opts)
+    Returns (HEFactors, X); under ``ErrorPolicy.Info``,
+    ``(F, X, HealthInfo)``.
+
+    A singular band T (no pivoting escape inside Aasen's tridiagonal
+    factor) falls back to densified LU ``gesv`` when
+    ``Option.UseFallbackSolver`` is set — see
+    ``recovery.hesv_with_recovery``."""
+    from ..robust.recovery import hesv_with_recovery
+    return hesv_with_recovery(A, B, opts)

@@ -12,9 +12,17 @@ import jax.numpy as jnp
 from ..core.matrix import (BaseTrapezoidMatrix, HermitianMatrix, Matrix,
                            TriangularMatrix)
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateSingularError, slate_error
 from ..options import Options
-from ..types import Uplo
+from ..robust import health as _health
+from ..types import Diag, Uplo
+
+
+def _singular_exc(name):
+    def make(h: _health.HealthInfo):
+        return SlateSingularError(f"{name}: {h.describe()}",
+                                  info=int(h.info))
+    return make
 
 
 def trtri(A: TriangularMatrix, opts: Options | None = None):
@@ -22,7 +30,12 @@ def trtri(A: TriangularMatrix, opts: Options | None = None):
     through the trsm driver, so the execution target follows trsm's:
     the dist_trsm substitution pipeline on a mesh (the reference's
     distributed trtri, src/trtri.cc:1-160), blocked substitution with
-    batched diagonal inverses single-target."""
+    batched diagonal inverses single-target.
+
+    Health: a zero diagonal entry of A makes op(A) exactly singular —
+    reported LAPACK-style as ``info = k`` (1-based index of the first
+    zero pivot) and resolved against ``Option.ErrorPolicy`` (raise /
+    NaN-fill / ``(X, HealthInfo)``)."""
     from .blas3 import trsm
     slate_error(isinstance(A, BaseTrapezoidMatrix), "trtri: need triangular")
     n = A.m
@@ -32,8 +45,15 @@ def trtri(A: TriangularMatrix, opts: Options | None = None):
     X = trsm("l", 1.0, A, I, opts)
     # result has the effective (logical) triangle of op(A)
     eff_lower = A._uplo_logical() is Uplo.Lower
-    return TriangularMatrix._from_view(
+    Xt = TriangularMatrix._from_view(
         X, Uplo.Lower if eff_lower else Uplo.Upper, A.diag)
+    if A.diag is Diag.Unit:
+        # unit diagonal is implicit 1s — never singular, skip the pivots
+        h = _health.from_result(X.storage.data)
+    else:
+        h = _health.merge(_health.from_pivots(jnp.diagonal(A.to_dense())),
+                          _health.from_result(X.storage.data))
+    return _health.finalize("trtri", Xt, h, opts, _singular_exc("trtri"))
 
 
 def trtrm(L: TriangularMatrix, opts: Options | None = None):
@@ -47,5 +67,8 @@ def trtrm(L: TriangularMatrix, opts: Options | None = None):
     C0 = HermitianMatrix._from_view(
         Matrix.zeros(n, n, nb, nb, L.grid, L.dtype), Uplo.Lower)
     if L._uplo_logical() is Uplo.Lower:
-        return herk(1.0, L.conj_transpose().general(), 0.0, C0, opts)
-    return herk(1.0, L.general(), 0.0, C0, opts)
+        C = herk(1.0, L.conj_transpose().general(), 0.0, C0, opts)
+    else:
+        C = herk(1.0, L.general(), 0.0, C0, opts)
+    h = _health.from_result(C.storage.data)
+    return _health.finalize("trtrm", C, h, opts, _singular_exc("trtrm"))

@@ -181,7 +181,7 @@ def _getrf(A: Matrix, opts: Options | None, method: str):
         F = LUFactors(Matrix(out), perm[: st.m])
         h = _lu_health(clean, minpiv, minidx, amax)
         return _health.finalize(f"getrf[{method}]", F, h, opts,
-                                _singular(method))
+                                _singular(f"getrf[{method}]"))
 
     ad = faults.maybe_corrupt("input", A.to_dense())
     amax = jnp.max(jnp.abs(ad))
@@ -193,12 +193,12 @@ def _getrf(A: Matrix, opts: Options | None, method: str):
     minidx = jnp.argmin(udiag)
     h = _lu_health(lu, udiag[minidx], minidx, amax)
     return _health.finalize(f"getrf[{method}]", F, h, opts,
-                            _singular(method))
+                            _singular(f"getrf[{method}]"))
 
 
-def _singular(method: str):
+def _singular(name: str):
     return lambda h: SlateSingularError(
-        f"getrf[{method}]: exactly-singular or non-finite factor "
+        f"{name}: exactly-singular or non-finite factor "
         f"({h.describe()})", info=int(h.info))
 
 
@@ -245,18 +245,41 @@ def gesv_nopiv(A: Matrix, B, opts: Options | None = None):
     return gesv_nopiv_raw(A, B, opts)
 
 
+def _getri_health(F: LUFactors, X: Matrix):
+    """Inverse health: a zero/non-finite U pivot means the factor is
+    exactly singular (LAPACK getri's info = k contract) — checked here
+    because getri is often handed factors produced under Info/Nan
+    policies that deliberately did not raise at factor time."""
+    udiag = jnp.diagonal(F.LU.to_dense())
+    return _health.merge(_health.from_pivots(udiag),
+                         _health.from_result(X.storage.data))
+
+
 @annotate("slate.getri")
 def getri(F: LUFactors, opts: Options | None = None) -> Matrix:
     """In-place-style inverse from LU factors (ref: src/getri.cc):
-    A^-1 = U^-1 L^-1 P."""
+    A^-1 = U^-1 L^-1 P.
+
+    Failure contract: a singular factor (zero U pivot) resolves per
+    ``Option.ErrorPolicy`` — eager raise of :class:`SlateSingularError`
+    with ``info = k``, NaN-fill, or ``(X, HealthInfo)``."""
     n = F.LU.m
     eye = jnp.eye(n, dtype=F.LU.dtype)
     I = Matrix(TileStorage.from_dense(eye, F.LU.mb, F.LU.nb, F.LU.grid))
-    return getrs(F, I, opts)
+    X = getrs(F, I, opts)
+    return _health.finalize("getri", X, _getri_health(F, X), opts,
+                            _singular("getri"))
 
 
 @annotate("slate.getriOOP")
 def getriOOP(A: Matrix, opts: Options | None = None) -> Matrix:
-    """Out-of-place inverse (ref: src/getriOOP.cc): factor + solve vs I."""
+    """Out-of-place inverse (ref: src/getriOOP.cc): factor + solve vs I.
+    Under ``ErrorPolicy.Info`` returns ``(X, HealthInfo)`` with the
+    factor and solve healths merged."""
+    from ..options import ErrorPolicy
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        F, fh = getrf(A, opts)
+        X, ih = getri(F, opts)
+        return X, _health.merge(fh, ih)
     F = getrf(A, opts)
     return getri(F, opts)

@@ -40,6 +40,11 @@ from jax import lax
 
 import numpy as np
 
+from ..exceptions import SlateNotConvergedError
+from ..options import Options
+from ..robust import certify as _certify
+from ..robust import faults as _faults
+from ..robust import health as _health
 from ..types import eps as _eps
 
 LEAF = 32
@@ -167,12 +172,21 @@ def _merge_gemm(Q0, ut, grid):
 
 def _merge(d1, Q1, d2, Q2, rho, grid=None):
     """Eigendecomposition of [[T1, rho e e^T], [rho e e^T, T2]] given the
-    halves' decompositions (ref: stedc_merge.cc)."""
+    halves' decompositions (ref: stedc_merge.cc).
+
+    Returns ``(lam, Qm, ok)`` — ``ok`` is a traced scalar bool ANDing the
+    deflation-mask NaN guard (a NaN z survives the ``<= tol`` deflation
+    comparisons silently, so finiteness is checked BEFORE the masks) with
+    the secular-root sanity check (finite and inside the merged spectrum's
+    span; LAPACK's laed4 reports the same condition through ``info``)."""
     dt = d1.dtype
     n1 = d1.shape[0]
     d = jnp.concatenate([d1, d2])
     n = d.shape[0]
     z = jnp.concatenate([Q1[-1, :], Q2[0, :]])
+    # deflation-mask NaN guard: NaN compares False against tol, so a
+    # poisoned z/d would silently stay "active" — flag it here instead
+    defl_ok = jnp.all(jnp.isfinite(d)) & jnp.all(jnp.isfinite(z))
     # mirror to rho > 0: eig(D + rho z z^T) = -eig(-D + (-rho) z z^T)
     sgn = jnp.where(rho >= 0, jnp.ones((), dt), -jnp.ones((), dt))
     dm = sgn * d
@@ -230,10 +244,18 @@ def _merge(d1, Q1, d2, Q2, rho, grid=None):
     na = jnp.sum(act.astype(jnp.int32))
 
     delta, use_up = _secular_roots(cd, cz * cz, rho_eff, na)
+    delta = _faults.maybe_corrupt("post_secular", delta)
+    i_all = jnp.arange(n)
+    # secular sanity: every active root offset must be finite and inside
+    # the merged spectrum's span (bisection guarantees |delta| <= gap;
+    # anything outside means the solve — or the data under it — is bad)
+    span = (jnp.max(cd) - jnp.min(cd)) + jnp.abs(rho_eff)
+    sec_ok = jnp.all(jnp.where(
+        i_all < na,
+        jnp.isfinite(delta) & (jnp.abs(delta) <= span + tol), True))
     # anchored lambda_i - cd_j: (cd_anchor_i - cd_j) + delta_i, where
     # anchor_i = i (+1 for upper-anchored roots) — every factor carries
     # full relative accuracy near both poles
-    i_all = jnp.arange(n)
     anchor = jnp.clip(i_all + use_up.astype(i_all.dtype), 0, n - 1)
     anchor_d = cd[anchor]
     num = (anchor_d[:, None] - cd[None, :]) + delta[:, None]
@@ -275,7 +297,7 @@ def _merge(d1, Q1, d2, Q2, rho, grid=None):
     # undo the mirror, final ascending sort
     lam = sgn * lam_c
     fin = jnp.argsort(lam)
-    return lam[fin], Qm[:, fin]
+    return lam[fin], Qm[:, fin], defl_ok & sec_ok
 
 
 def _stedc_rec(d, e, grid=None):
@@ -284,19 +306,54 @@ def _stedc_rec(d, e, grid=None):
         T = jnp.diag(d)
         if n > 1:
             T = T + jnp.diag(e, 1) + jnp.diag(e, -1)
-        return jnp.linalg.eigh(T)
+        w, Q = jnp.linalg.eigh(T)
+        return w, Q, jnp.asarray(True)
     m = n // 2
     rho = e[m - 1]
     d1 = d[:m].at[m - 1].add(-rho)
     d2 = d[m:].at[0].add(-rho)
-    w1, Q1 = _stedc_rec(d1, e[: m - 1], grid)
-    w2, Q2 = _stedc_rec(d2, e[m:], grid)
-    return _merge(w1, Q1, w2, Q2, rho, grid)
+    w1, Q1, ok1 = _stedc_rec(d1, e[: m - 1], grid)
+    w2, Q2, ok2 = _stedc_rec(d2, e[m:], grid)
+    lam, Qm, okm = _merge(w1, Q1, w2, Q2, rho, grid)
+    return lam, Qm, ok1 & ok2 & okm
 
 
-def stedc(d, e, grid=None):
+def stedc_info(d, e, grid=None, certify=True):
+    """stedc compute body: ``((w, Z), HealthInfo)``, no policy resolution.
+
+    The health merges (a) the per-merge traced flags — secular-bisection
+    sanity and the deflation-mask NaN guard — ANDed across the recursion
+    into ``converged``, and (b) the a-posteriori eigen-certificate of the
+    final (w, Z) against the tridiagonal itself (``certify.certify_eig``;
+    assembling T densely is O(n^2), cheaper than one merge gemm).
+    ``certify=False`` skips (b) — for callers like heev's DC route that
+    certify their own final result against the original matrix, where a
+    tridiagonal-level certificate would be redundant work."""
+    d = jnp.asarray(d)
+    e = jnp.asarray(e)
+    if d.shape[0] == 1:
+        w, Z = d, jnp.ones((1, 1), d.dtype)
+        return (w, Z), _health.from_result(w)
+    # pin true-precision matmuls: the merge gemm Qm = Q0 @ U accumulates
+    # across O(log n) levels, and TPU's default bf16-pass matmul costs
+    # ~3 digits of orthogonality per level (measured ~2e-2 vs ~1e-4 at
+    # n=64 f32) — same discipline as hetrf's recurrence gemms
+    with jax.default_matmul_precision("highest"):
+        w, Z, ok = _stedc_rec(d, e, grid)
+        flags = _health.healthy(d.dtype)._replace(converged=ok)
+        if not certify:
+            return (w, Z), _health.merge(flags, _health.from_result(w))
+        T = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
+        cert = _certify.certify_eig(T, w, Z)
+    return (w, Z), _health.merge(cert, flags, _health.from_result(w))
+
+
+def stedc(d, e, grid=None, opts: Options | None = None):
     """Eigendecomposition of the symmetric tridiagonal (d, e) by divide &
-    conquer (ref: src/stedc.cc).  Returns (w, Z) ascending.
+    conquer (ref: src/stedc.cc).  Returns (w, Z) ascending; under
+    ``ErrorPolicy.Info``, ``(w, Z, HealthInfo)`` — the health carries the
+    secular/deflation traced flags in ``converged`` plus the residual and
+    orthogonality certificate (docs/ROBUSTNESS.md).
 
     ``grid``: a slate Grid whose mesh (if any) row-distributes every
     merge's eigenvector gemm (the reference's stedc_merge rank layout);
@@ -306,13 +363,9 @@ def stedc(d, e, grid=None):
     Use float64 (CPU backend) for LAPACK-grade orthogonality; the f32
     path (TPU) uses dtype-calibrated exp/log guards and delivers
     f32-grade (~1e-6 * ||T||) residuals."""
-    d = jnp.asarray(d)
-    e = jnp.asarray(e)
-    if d.shape[0] == 1:
-        return d, jnp.ones((1, 1), d.dtype)
-    # pin true-precision matmuls: the merge gemm Qm = Q0 @ U accumulates
-    # across O(log n) levels, and TPU's default bf16-pass matmul costs
-    # ~3 digits of orthogonality per level (measured ~2e-2 vs ~1e-4 at
-    # n=64 f32) — same discipline as hetrf's recurrence gemms
-    with jax.default_matmul_precision("highest"):
-        return _stedc_rec(d, e, grid)
+    (w, Z), h = stedc_info(d, e, grid)
+    return _health.finalize_flat(
+        "stedc", (w, Z), h, opts,
+        lambda hh: SlateNotConvergedError(
+            f"stedc: secular solve / certification failed "
+            f"({hh.describe()})", iters=int(hh.iters)))

@@ -25,14 +25,23 @@ from jax import lax
 
 from ..core.matrix import Matrix
 from ..core.storage import TileStorage
-from ..exceptions import slate_error
+from ..exceptions import SlateNotConvergedError, slate_error
 from ..internal.qr import (apply_q_left, apply_q_right,
                            householder_panel_blocked, householder_vec,
                            phase_of)
-from ..options import (MethodSvd, Option, Options, Target, get_option,
-                       resolve_target)
+from ..options import (ErrorPolicy, MethodSvd, Option, Options, Target,
+                       get_option, resolve_target)
+from ..robust import certify as _certify
+from ..robust import faults as _faults
+from ..robust import health as _health
 from ..types import Op, is_complex
 from ..util.trace import annotate
+
+
+def _notconv_exc(name):
+    return lambda h: SlateNotConvergedError(
+        f"{name}: singular value decomposition failed certification "
+        f"({h.describe()})", iters=int(h.iters))
 
 
 # ---------------------------------------------------------------- stage 1
@@ -217,27 +226,37 @@ def _bd_svd(d, e, want_uv: bool):
     return jnp.linalg.svd(B, compute_uv=False), None, None
 
 
-def bdsqr(d, e):
+def bdsqr(d, e, opts: Options | None = None):
     """SVD of a real upper bidiagonal (d, e) as a public driver
-    (ref: src/bdsqr.cc wrapping lapack::bdsqr).  Returns (s, U, Vh)."""
-    return _bd_svd(jnp.asarray(d), jnp.asarray(e), True)
+    (ref: src/bdsqr.cc wrapping lapack::bdsqr).  Returns (s, U, Vh);
+    under ``ErrorPolicy.Info``, ``(s, U, Vh, HealthInfo)``."""
+    s, U, Vh = _bd_svd(jnp.asarray(d), jnp.asarray(e), True)
+    return _health.finalize_flat("bdsqr", (s, U, Vh),
+                                 _health.from_result(s), opts,
+                                 _notconv_exc("bdsqr"))
 
 
 @annotate("slate.tb2bd")
-def tb2bd(TB, *, want_uv: bool = True):
+def tb2bd(TB, opts: Options | None = None, *, want_uv: bool = True):
     """Band -> bidiagonal bulge chase as a public driver
     (ref: src/tb2bd.cc): takes a TriangularBandMatrix (upper), returns
-    (d, e, U2, V2) with band = U2 B V2^H."""
+    (d, e, U2, V2) with band = U2 B V2^H; under ``ErrorPolicy.Info``,
+    ``(d, e, U2, V2, HealthInfo)``."""
     from ..core.matrix import TriangularBandMatrix
     slate_error(isinstance(TB, TriangularBandMatrix),
                 "tb2bd: need TriangularBandMatrix")
-    return _tb2bd(TB.to_dense(), TB.kd, want_uv=want_uv)
+    d, e, U2, V2 = _tb2bd(TB.to_dense(), TB.kd, want_uv=want_uv)
+    h = _health.merge(_health.from_result(d), _health.from_result(e))
+    return _health.finalize_flat("tb2bd", (d, e, U2, V2), h, opts,
+                                 _notconv_exc("tb2bd"))
 
 
 def _stage2_svd(band, nb: int, jobu: bool, opts: Options | None):
     """Stage 2 + small-problem seam, method-dispatched (the MethodSvd
-    consumer).  Returns (s, Un, Vn) with band = Un diag(s) Vn^H
-    (Un/Vn None when jobu=False).
+    consumer).  Returns (s, Un, Vn, HealthInfo) with
+    band = Un diag(s) Vn^H (Un/Vn None when jobu=False); the fault sites
+    ``post_stage1`` (the band handed to stage 2) and ``post_chase`` (the
+    chased bidiagonal) fire here.
 
     Auto: SVD the band DIRECTLY with XLA's svd — the tb2bd chase's
     sequential scan is pure latency when the downstream kernel is O(n^3)
@@ -245,19 +264,24 @@ def _stage2_svd(band, nb: int, jobu: bool, opts: Options | None):
     where the chase feeds O(n^2) bdsqr, which does pay).
     Bidiag: the parity route — tb2bd bulge chase to a true bidiagonal,
     then the bdsqr-analog seam."""
+    band = _faults.maybe_corrupt("post_stage1", band)
     meth = get_option(opts, Option.MethodSvd)
     if meth is MethodSvd.Auto:
         if jobu:
             Ub, s, Vbh = jnp.linalg.svd(band)
-            return s, Ub, jnp.conj(Vbh).T
-        return jnp.linalg.svd(band, compute_uv=False), None, None
+            return s, Ub, jnp.conj(Vbh).T, _health.from_result(s)
+        s = jnp.linalg.svd(band, compute_uv=False)
+        return s, None, None, _health.from_result(s)
     d, e, U2, V2 = _tb2bd(band, nb, want_uv=jobu)
+    d = _faults.maybe_corrupt("post_chase", d)
     s, Ub, Vbh = _bd_svd(d, e, jobu)
+    h = _health.merge(_health.from_result(d), _health.from_result(e),
+                      _health.from_result(s))
     if not jobu:
-        return s, None, None
+        return s, None, None, h
     Un = U2 @ Ub.astype(U2.dtype)
     Vn = V2 @ jnp.conj(Vbh.astype(V2.dtype)).T
-    return s, Un, Vn
+    return s, Un, Vn, h
 
 
 def _unmbr_ge2tb_u(Vqs, Tqs, nb: int, Z):
@@ -276,39 +300,70 @@ def _unmbr_ge2tb_v(Vls, Tls, nb: int, Z):
     return rolled_apply(Vls, Tls, (jnp.arange(K) + 1) * nb, Z)
 
 
-@annotate("slate.svd")
-def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
-    """Singular value decomposition A = U diag(s) V^H (ref: src/svd.cc).
-
-    Returns (s, U, V) with thin U [m, r], V [n, r], r = min(m, n);
-    (s, None, None) when jobu=False.  m < n handled by factoring A^H."""
+def _svd_compute(A: Matrix, opts: Options | None, jobu: bool):
+    """svd compute recursion: ``(s, Um, Vm, HealthInfo)``, no policy and
+    no certificate — the m < n case recurses on A^H with U/V swapped, and
+    certification must happen exactly once at the svd_info boundary."""
     slate_error(type(A) is Matrix,
                 "svd: need a general Matrix (convert structured types "
                 "with .general())")
     m, n = A.m, A.n
     if m < n:
-        s, V, U = svd(_conj_t_root(A), opts, jobu=jobu)
-        return s, U, V
+        s, V, U, h = _svd_compute(_conj_t_root(A), opts, jobu)
+        return s, U, V, h
     if resolve_target(opts, A) is Target.mesh and A.grid.mesh is not None:
         return _svd_mesh(A, opts, jobu)
     nb = A.nb
     ad = A.to_dense()
     Vqs, Tqs, Vls, Tls, Ds, Ss = _ge2tb_scan(ad, nb)
     band = _band_upper_from_stacks(Ds, Ss, n, nb)
-    s, Un, Vn = _stage2_svd(band, nb, jobu, opts)
+    s, Un, Vn, h = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
-        return s, None, None
+        return s, None, None, h
     dt = ad.dtype
     Mp = Vqs.shape[1]
     Np = -(-n // nb) * nb
     Upad = jnp.zeros((Mp, n), dt).at[:n, :n].set(Un.astype(dt))
     Ufull = _unmbr_ge2tb_u(Vqs, Tqs, nb, Upad)[:m]
+    Ufull = _faults.maybe_corrupt("post_backtransform", Ufull)
     Vpad = jnp.zeros((Np, n), dt).at[:n].set(Vn.astype(dt))
     Vfull = _unmbr_ge2tb_v(Vls, Tls, nb, Vpad)[:n]
     g = A.grid
     Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
     Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
-    return s, Um, Vm
+    return s, Um, Vm, h
+
+
+def svd_info(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
+    """svd compute body: ``((s, Um, Vm), HealthInfo)``, no policy
+    resolution (the recovery layer escalates on this seam).  The health
+    merges the stage-2 flags with the a-posteriori SVD certificate of the
+    back-transformed factors against the ORIGINAL A
+    (``certify.certify_svd``)."""
+    s, Um, Vm, h = _svd_compute(A, opts, jobu)
+    if jobu:
+        h = _health.merge(
+            _certify.certify_svd(A.to_dense(), s, Um.to_dense(),
+                                 Vm.to_dense()), h)
+    else:
+        h = _health.merge(_health.from_result(s), h)
+    return (s, Um, Vm), h
+
+
+@annotate("slate.svd")
+def svd(A: Matrix, opts: Options | None = None, *, jobu: bool = True):
+    """Singular value decomposition A = U diag(s) V^H (ref: src/svd.cc).
+
+    Returns (s, U, V) with thin U [m, r], V [n, r], r = min(m, n);
+    (s, None, None) when jobu=False; under ``ErrorPolicy.Info`` the
+    HealthInfo is appended.  m < n handled by factoring A^H.
+
+    Every result is a-posteriori certified (residual + left/right
+    orthogonality, robust/certify.py); an eager certification failure
+    escalates MethodSvd Auto -> Bidiag before the ErrorPolicy resolves —
+    see ``recovery.svd_with_recovery`` and docs/ROBUSTNESS.md."""
+    from ..robust.recovery import svd_with_recovery
+    return svd_with_recovery(A, opts, jobu=jobu)
 
 
 def _band_upper_from_tiles(st, n: int, nb: int):
@@ -350,9 +405,9 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
     # ONE stage-2 dispatch shared with the single-target path (stage 2 is
     # single-node by design, as the reference's is); only the stage-1
     # back-transforms below are mesh-distributed
-    s, Uns, Vns = _stage2_svd(band, nb, jobu, opts)
+    s, Uns, Vns, h = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
-        return s, None, None
+        return s, None, None, h
     dt = st_packed.dtype
     Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
     Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
@@ -367,16 +422,22 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
     uf_data = fs_.data.at[dst].set(us_.data[src])
     Uf = Matrix(TileStorage(uf_data, m, n, nb, nb, grid))
     u_data = dist_unmbr_ge2tb_u(data, Tqs, Uf.storage.data, grid, m)
+    u_data = _faults.maybe_corrupt("post_backtransform", u_data)
     v_data = dist_unmbr_ge2tb_v(data, Tls, Vn.storage.data, grid, n)
     us, vs = Uf.storage, Vn.storage
     Um = Matrix(TileStorage(u_data, us.m, us.n, us.mb, us.nb, us.grid))
     Vm = Matrix(TileStorage(v_data, vs.m, vs.n, vs.mb, vs.nb, vs.grid))
-    return s, Um, Vm
+    return s, Um, Vm, h
 
 
 def svd_vals(A: Matrix, opts: Options | None = None):
-    """Singular values only (ref: simplified_api svd_vals)."""
-    return svd(A, opts, jobu=False)[0]
+    """Singular values only (ref: simplified_api svd_vals).  Under
+    ``ErrorPolicy.Info`` returns ``(s, HealthInfo)``."""
+    res = svd(A, opts, jobu=False)
+    if _health.error_policy(opts) is ErrorPolicy.Info:
+        s, _, _, h = res
+        return s, h
+    return res[0]
 
 
 def _conj_t_root(A) -> Matrix:

@@ -1,25 +1,34 @@
-"""Numerical-health subsystem: jit-safe info codes, fault injection, and
-driver-level recovery/escalation.
+"""Numerical-health subsystem: jit-safe info codes, fault injection,
+a-posteriori certification, and driver-level recovery/escalation.
 
-Three parts (see docs/ROBUSTNESS.md for the per-driver contract table):
+Four parts (see docs/ROBUSTNESS.md for the per-driver contract table):
 
 - :mod:`health`   — the ``HealthInfo`` pytree threaded through the factor
   and solve drivers, plus the ``Option.ErrorPolicy`` resolution that
   unifies the eager-raise vs traced-NaN contracts.
+- :mod:`certify`  — cheap a-posteriori residual/orthogonality certificates
+  for the spectral drivers (heev/svd/hetrf), whose decompositions carry no
+  pivot record to read failure from.
 - :mod:`faults`   — a deterministic, seeded fault injector that corrupts
-  named sites (input tiles, post-panel factors, post-collective results)
-  so detection and recovery paths are testable on CPU.
+  named sites (input tiles, post-panel factors, post-collective results,
+  the two-stage spectral pipeline) so detection and recovery paths are
+  testable on CPU.
 - :mod:`recovery` — driver-level graceful degradation: LU pivoting
   escalation (NoPiv -> PartialPiv -> CALU), posv -> hesv/gesv fallback on
-  non-HPD input, and the bounded-retry policy the mixed-precision
-  full-precision fallback routes through.
+  non-HPD input, certification-gated spectral method escalation
+  (heev Auto -> DC -> QR, svd Auto -> Bidiag, hesv -> gesv), and the
+  bounded-retry policy the mixed-precision fallback routes through.
 """
 
 from .health import (  # noqa: F401
-    HealthInfo, error_policy, finalize, from_pivots, from_result, healthy,
-    merge, poison,
+    HealthInfo, error_policy, finalize, finalize_flat, from_pivots,
+    from_result, healthy, merge, poison,
+)
+from .certify import (  # noqa: F401
+    certify_eig, certify_ldlt, certify_svd, tolerance,
 )
 from .faults import FaultPlan, inject, maybe_corrupt  # noqa: F401
 from .recovery import (  # noqa: F401
-    bounded_retry, gesv_with_recovery, posv_with_recovery,
+    bounded_retry, gesv_with_recovery, heev_with_recovery,
+    hesv_with_recovery, posv_with_recovery, svd_with_recovery,
 )

@@ -1,0 +1,154 @@
+"""A-posteriori certification of spectral results (jit-compatible).
+
+The factor/solve drivers can read failure off their own pivots; the
+spectral drivers cannot — a NaN-poisoned bulge chase, a non-converged
+secular solve, or a silently bit-flipped band all produce *finite-looking*
+eigenpairs with nothing in the decomposition itself to flag them.  The
+LAPACK testers (and the tiled-accelerator verification loops of
+"Evaluating Spatial Accelerator Architectures with Tiled Matrix-Matrix
+Multiplication", PAPERS.md) close that gap with cheap residual checks
+against the ORIGINAL input; this module packages those checks as
+:class:`~slate_tpu.robust.health.HealthInfo` producers so the spectral
+drivers join the same ErrorPolicy/recovery machinery as the factor
+drivers (docs/ROBUSTNESS.md).
+
+Each certificate costs O(n) gemm flops against the driver's O(n^2..n^3)
+factor flops — one or two dense products plus Frobenius reductions — and
+is pure jnp, so it traces through jit/shard_map unchanged.
+
+Certificate -> HealthInfo mapping:
+
+- ``converged``        False when any residual ratio exceeds the tolerance
+- ``growth``           the worst residual ratio (decomposition residual or
+                       orthogonality defect, whichever is larger)
+- ``min_pivot_index``  0-based column index of the worst residual column
+- ``nonfinite``        any NaN/Inf in the certified factors
+
+``min_pivot`` stays +inf so merging a certificate with a factorization
+health (hesv: band-T pivots + LDLT certificate) preserves the factor's
+real pivot record.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import health as _health
+
+
+def tolerance(dtype, n: int, factor: float = 50.0) -> float:
+    """Dtype-calibrated certification tolerance: ``factor * n * eps`` of
+    the REAL dtype.  Measured clean residual ratios sit at ~0.5 n eps
+    (heev/svd, all method routes) and ~0.1 n eps (Aasen), so 50 n eps
+    accepts every healthy route with a wide margin while a single
+    exponent-bit flip (x 2^100) overshoots it by ~80 orders of magnitude.
+    Host float — static under jit (dtype and n are trace constants)."""
+    import numpy as np
+    rdt = np.finfo(np.dtype(dtype)).dtype if np.issubdtype(
+        np.dtype(dtype), np.inexact) else np.float64
+    return float(factor * max(int(n), 1) * np.finfo(rdt).eps)
+
+
+def _fro(x):
+    """Frobenius norm, real result for any dtype."""
+    ax = jnp.abs(x)
+    return jnp.sqrt(jnp.sum(ax * ax))
+
+
+def certify_eig(a, w, v, *, tol: float | None = None) -> _health.HealthInfo:
+    """Certificate for A = V diag(w) V^H: relative residual
+    ``||A V - V diag(w)||_F / ||A||_F`` and orthogonality defect
+    ``||V^H V - I||_F / sqrt(n)``, each vs :func:`tolerance`.
+
+    ``a`` and ``v`` are dense [n, n]; ``w`` is the real spectrum [n]."""
+    a = jnp.asarray(a)
+    v = jnp.asarray(v)
+    w = jnp.asarray(w)
+    n = a.shape[0]
+    if tol is None:
+        tol = tolerance(a.dtype, n)
+    R = a @ v - v * w[None, :].astype(v.dtype)
+    col = jnp.sum(jnp.abs(R) * jnp.abs(R), axis=0)
+    worst = jnp.argmax(col).astype(jnp.int32)
+    tiny = jnp.asarray(jnp.finfo(col.dtype).tiny, col.dtype)
+    resid = _fro(R) / jnp.maximum(_fro(a), tiny)
+    gram = jnp.conj(v).T @ v - jnp.eye(n, dtype=v.dtype)
+    ortho = _fro(gram) / jnp.sqrt(jnp.asarray(float(max(n, 1)), col.dtype))
+    finite = (jnp.all(jnp.isfinite(jnp.abs(v)))
+              & jnp.all(jnp.isfinite(w)))
+    ratio = jnp.maximum(resid, ortho)
+    h = _health.healthy(a.dtype)
+    return h._replace(
+        nonfinite=~finite,
+        min_pivot_index=worst,
+        growth=ratio.astype(h.growth.dtype),
+        converged=finite & (resid <= tol) & (ortho <= tol),
+    )
+
+
+def certify_svd(a, s, u, v, *, tol: float | None = None) \
+        -> _health.HealthInfo:
+    """Certificate for A = U diag(s) V^H (thin factors, r = min(m, n)):
+    relative residual ``||A - U diag(s) V^H||_F / ||A||_F`` plus left and
+    right orthogonality defects, each vs :func:`tolerance` at max(m, n)."""
+    a = jnp.asarray(a)
+    u = jnp.asarray(u)
+    v = jnp.asarray(v)
+    s = jnp.asarray(s)
+    m, n = a.shape
+    r = min(m, n)
+    if tol is None:
+        tol = tolerance(a.dtype, max(m, n))
+    ur = u[:, :r]
+    vr = v[:, :r]
+    R = a - (ur * s[None, :r].astype(ur.dtype)) @ jnp.conj(vr).T
+    col = jnp.sum(jnp.abs(R) * jnp.abs(R), axis=0)
+    worst = jnp.argmax(col).astype(jnp.int32)
+    tiny = jnp.asarray(jnp.finfo(col.dtype).tiny, col.dtype)
+    resid = _fro(R) / jnp.maximum(_fro(a), tiny)
+    rnorm = jnp.sqrt(jnp.asarray(float(max(r, 1)), col.dtype))
+    ou = _fro(jnp.conj(ur).T @ ur - jnp.eye(r, dtype=ur.dtype)) / rnorm
+    ov = _fro(jnp.conj(vr).T @ vr - jnp.eye(r, dtype=vr.dtype)) / rnorm
+    finite = (jnp.all(jnp.isfinite(jnp.abs(u)))
+              & jnp.all(jnp.isfinite(jnp.abs(v)))
+              & jnp.all(jnp.isfinite(s)))
+    ratio = jnp.maximum(jnp.maximum(resid, ou), ov)
+    h = _health.healthy(a.dtype)
+    return h._replace(
+        nonfinite=~finite,
+        min_pivot_index=worst,
+        growth=ratio.astype(h.growth.dtype),
+        converged=finite & (resid <= tol) & (ou <= tol) & (ov <= tol),
+    )
+
+
+def certify_ldlt(a, L, T, piv, *, tol: float | None = None) \
+        -> _health.HealthInfo:
+    """Certificate for the blocked Aasen factorization
+    ``P A P^H = L T L^H``: relative residual
+    ``||A[piv][:, piv] - L T L^H||_F / ||A||_F`` vs :func:`tolerance`.
+
+    ``a`` dense Hermitian [n, n]; ``L`` unit lower [n, n]; ``T`` the
+    assembled band [n, n] (``HEFactors.T_dense()``); ``piv`` the symmetric
+    permutation (may be traced — applied as a gather)."""
+    a = jnp.asarray(a)
+    L = jnp.asarray(L)
+    T = jnp.asarray(T)
+    n = a.shape[0]
+    if tol is None:
+        tol = tolerance(a.dtype, n)
+    ap = a[piv][:, piv]
+    R = ap - L @ T @ jnp.conj(L).T
+    col = jnp.sum(jnp.abs(R) * jnp.abs(R), axis=0)
+    worst = jnp.argmax(col).astype(jnp.int32)
+    tiny = jnp.asarray(jnp.finfo(col.dtype).tiny, col.dtype)
+    resid = _fro(R) / jnp.maximum(_fro(a), tiny)
+    finite = (jnp.all(jnp.isfinite(jnp.abs(L)))
+              & jnp.all(jnp.isfinite(jnp.abs(T))))
+    h = _health.healthy(a.dtype)
+    return h._replace(
+        nonfinite=~finite,
+        min_pivot_index=worst,
+        growth=resid.astype(h.growth.dtype),
+        converged=finite & (resid <= tol),
+    )

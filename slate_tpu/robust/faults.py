@@ -21,11 +21,25 @@ Named sites (see docs/ROBUSTNESS.md):
 ``post_collective`` a collective result (SUMMA accumulator, broadcast
                    X row in the distributed trsm sweep)
 ``solve``          the computed solution X
+``post_stage1``    the band matrix produced by stage 1 of the two-stage
+                   reductions (he2hb / ge2tb), before stage 2 consumes it
+``post_chase``     the tri/bidiagonal output of the stage-2 bulge chase
+                   (hb2st / tb2bd), before the small-problem eigensolver
+``post_secular``   the secular-equation roots inside the stedc D&C merge
+``post_backtransform`` the accumulated eigen/singular vectors after the
+                   stage-1 back-transform (unmtr_he2hb / unmbr_ge2tb)
 =================  =====================================================
 
 Payloads: ``nan``, ``inf``, and ``bitflip`` — a high-exponent-bit flip
 (value scaled by 2^100), the silent-data-corruption payload that stays
 FINITE and is only caught by pivot-growth / residual checks.
+
+Plans are PERSISTENT by default: the corruption re-fires every time the
+site is reached while the plan is active (a stuck-at fault).  Pass
+``transient=True`` for single-shot SDC semantics — the plan deactivates
+after its first strike, so a recovery retry (e.g. heev escalating
+Auto -> DC -> QR) sees clean data on the second attempt, which is exactly
+how a transient bit-flip behaves in production.
 """
 
 from __future__ import annotations
@@ -36,7 +50,8 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-SITES = ("input", "post_panel", "post_collective", "solve")
+SITES = ("input", "post_panel", "post_collective", "solve",
+         "post_stage1", "post_chase", "post_secular", "post_backtransform")
 KINDS = ("nan", "inf", "bitflip")
 
 # flipping exponent bit 6 of an O(1) value: finite, wildly wrong
@@ -52,6 +67,9 @@ class FaultPlan:
     kind: str = "nan"
     seed: int = 0
     count: int = 1
+    # transient faults strike once and deactivate (single-shot SDC);
+    # the default is a stuck-at fault that re-fires on every pass.
+    transient: bool = False
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -109,8 +127,11 @@ def corrupt(x, plan: FaultPlan):
 
 
 def maybe_corrupt(site: str, x):
-    """The site hook drivers call: identity unless a plan is active."""
+    """The site hook drivers call: identity unless a plan is active.
+    A ``transient`` plan deactivates after its first strike."""
     plan = _ACTIVE.get(site)
     if plan is None:
         return x
+    if plan.transient:
+        del _ACTIVE[site]
     return corrupt(x, plan)

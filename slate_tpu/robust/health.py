@@ -158,10 +158,11 @@ def poison(tree, h: HealthInfo):
     the ErrorPolicy.Nan guarantee that a failed result is never finite
     garbage."""
     def leaf(x):
-        x = jnp.asarray(x)
-        if not jnp.issubdtype(x.dtype, jnp.inexact):
-            return x
-        return jnp.where(h.ok, x, jnp.full_like(x, jnp.nan))
+        xa = jnp.asarray(x)
+        if not jnp.issubdtype(xa.dtype, jnp.inexact):
+            return x          # untouched: static ints (e.g. a block size
+        #                       riding in a factor pytree) must stay ints
+        return jnp.where(h.ok, xa, jnp.full_like(xa, jnp.nan))
     return jax.tree_util.tree_map(leaf, tree)
 
 
@@ -187,6 +188,19 @@ def finalize(name: str, result, h: HealthInfo, opts: Options | None,
                else _default_exc(name, h))
         raise exc
     return result
+
+
+def finalize_flat(name: str, result: tuple, h: HealthInfo,
+                  opts: Options | None, make_exc=None):
+    """:func:`finalize` for tuple-shaped driver results (w, Z), (s, U, V):
+    under Info the HealthInfo is APPENDED to the tuple — ``(w, Z, h)`` —
+    instead of nesting ``((w, Z), h)``, matching the solver convention of
+    ``recovery._finalize_solve``."""
+    res = finalize(name, tuple(result), h, opts, make_exc)
+    if error_policy(opts) is ErrorPolicy.Info:
+        r, hh = res
+        return (*r, hh)
+    return res
 
 
 def _default_exc(name: str, h: HealthInfo):

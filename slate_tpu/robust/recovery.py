@@ -15,6 +15,15 @@ the same shape everywhere a cheap method can fail on hard inputs:
 - :func:`bounded_retry` — the shared policy: at most ``max_retries``
   fallback attempts, eager-only (a traced call cannot branch on health;
   it reports the HealthInfo instead), each attempt health-checked.
+- :func:`heev_with_recovery` / :func:`svd_with_recovery` —
+  certification-gated METHOD escalation for the spectral drivers:
+  when the a-posteriori certificate (:mod:`certify`) fails, heev walks
+  ``MethodEig`` Auto -> DC -> QR and svd walks ``MethodSvd``
+  Auto -> Bidiag (ScaLAPACK's documented ladder: D&C falls back to QR
+  iteration on non-convergence), re-certifying each attempt.
+- :func:`hesv_with_recovery` — a singular band T (Aasen's tridiagonal
+  factor has no pivoting to save it) falls back to plain LU ``gesv``
+  on the densified Hermitian matrix.
 
 Escalation requires host control flow, so it engages only on EAGER calls;
 traced calls run the requested method once and surface health per
@@ -23,9 +32,10 @@ traced calls run the requested method once and surface health per
 
 from __future__ import annotations
 
-from ..exceptions import (SlateNotPositiveDefiniteError, SlateSingularError)
-from ..options import (ErrorPolicy, MethodLU, Option, Options, get_option,
-                       select_lu_method)
+from ..exceptions import (SlateNotConvergedError,
+                          SlateNotPositiveDefiniteError, SlateSingularError)
+from ..options import (ErrorPolicy, MethodEig, MethodLU, MethodSvd, Option,
+                       Options, get_option, select_lu_method)
 from . import health as _h
 
 
@@ -164,6 +174,97 @@ def _gesv_attempt(A, B, opts):
     F, fh = _lu.getrf(Ag, o)
     X = _lu.getrs(F, B, o)
     return (F, X), _h.merge(fh, _h.from_result(X.storage.data))
+
+
+# ------------------------------------------------------------- heev / svd
+
+# ScaLAPACK's documented spectral ladder: divide-and-conquer falls back to
+# QR iteration on non-convergence.  Auto tries the vendor band eigensolver
+# first, then the explicit two-stage routes.
+_EIG_CHAIN = {
+    MethodEig.Auto: (MethodEig.Auto, MethodEig.DC, MethodEig.QR),
+    MethodEig.DC: (MethodEig.DC, MethodEig.QR),
+    MethodEig.QR: (MethodEig.QR,),
+}
+
+_SVD_CHAIN = {
+    MethodSvd.Auto: (MethodSvd.Auto, MethodSvd.Bidiag),
+    MethodSvd.Bidiag: (MethodSvd.Bidiag,),
+}
+
+
+def _notconverged_exc(name):
+    return lambda h: SlateNotConvergedError(
+        f"{name}: spectral result failed certification and escalation "
+        f"was exhausted ({h.describe()})", iters=int(h.iters))
+
+
+def heev_with_recovery(A, opts: Options | None = None, *, jobz: bool = True):
+    """heev body with certification-gated MethodEig escalation
+    (drivers/heev.py delegates here).
+
+    Each attempt returns ``((w, Z), HealthInfo)`` with the a-posteriori
+    eigen-certificate merged in (``certify.certify_eig``); a failed
+    certificate reads as ``converged=False`` so :func:`bounded_retry`
+    walks the Auto -> DC -> QR ladder.  Return shape: ``(w, Z)`` under
+    Raise/Nan, ``(w, Z, HealthInfo)`` under Info."""
+    from ..drivers import heev as _heev
+    chain = _EIG_CHAIN[get_option(opts, Option.MethodEig)]
+    if not get_option(opts, Option.UseFallbackSolver):
+        chain = chain[:1]
+
+    def attempt(m):
+        return _heev.heev_info(A, _with(opts, MethodEig=m), jobz=jobz)
+
+    (w, Z), h, _ = bounded_retry(
+        attempt(chain[0]),
+        [lambda m=m: attempt(m) for m in chain[1:]],
+        dtype=A.dtype, max_retries=len(chain))
+    return _h.finalize_flat("heev", (w, Z), h, opts,
+                            _notconverged_exc("heev"))
+
+
+def svd_with_recovery(A, opts: Options | None = None, *, jobu: bool = True):
+    """svd body with certification-gated MethodSvd escalation
+    (drivers/svd.py delegates here): Auto -> Bidiag, re-certified per
+    attempt.  Return shape: ``(s, U, V)`` under Raise/Nan,
+    ``(s, U, V, HealthInfo)`` under Info."""
+    from ..drivers import svd as _svd
+    chain = _SVD_CHAIN[get_option(opts, Option.MethodSvd)]
+    if not get_option(opts, Option.UseFallbackSolver):
+        chain = chain[:1]
+
+    def attempt(m):
+        return _svd.svd_info(A, _with(opts, MethodSvd=m), jobu=jobu)
+
+    (s, U, V), h, _ = bounded_retry(
+        attempt(chain[0]),
+        [lambda m=m: attempt(m) for m in chain[1:]],
+        dtype=A.dtype, max_retries=len(chain))
+    return _h.finalize_flat("svd", (s, U, V), h, opts,
+                            _notconverged_exc("svd"))
+
+
+# ------------------------------------------------------------------ hesv
+
+def hesv_with_recovery(A, B, opts: Options | None = None):
+    """hesv body with singular-band-T fallback (drivers/hetrf.py
+    delegates here): Aasen's tridiagonal T is factored without pivoting
+    beyond its band, so a singular T poisons the solve — fall back to
+    densified LU ``gesv`` when ``Option.UseFallbackSolver`` is set.
+
+    Return shape matches gesv's contract: ``(F, X)`` under Raise/Nan,
+    ``(F, X, HealthInfo)`` under Info."""
+    from ..drivers import hetrf as _he
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    F, fh = _he.hetrf(A, o)
+    X = _he.hetrs(F, B, o)
+    h = _h.merge(fh, _h.from_result(X.storage.data))
+    fallbacks = []
+    if get_option(opts, Option.UseFallbackSolver):
+        fallbacks = [lambda: _gesv_attempt(A, B, opts)]
+    (F, X), h, _ = bounded_retry(((F, X), h), fallbacks, dtype=A.dtype)
+    return _finalize_solve("hesv", F, X, h, opts, _singular_exc("hesv"))
 
 
 # ------------------------------------------------------------------ shared

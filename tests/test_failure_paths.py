@@ -9,9 +9,11 @@ import numpy as np
 import pytest
 
 import slate_tpu as st
-from slate_tpu.exceptions import (SlateNotPositiveDefiniteError,
+from slate_tpu.exceptions import (SlateNotConvergedError,
+                                  SlateNotPositiveDefiniteError,
                                   SlateSingularError)
-from slate_tpu.options import ErrorPolicy, MethodLU, Option, get_option
+from slate_tpu.options import (ErrorPolicy, MethodEig, MethodLU, MethodSvd,
+                               Option, get_option)
 from slate_tpu.robust import faults
 
 
@@ -265,6 +267,271 @@ def test_fault_injected_gesv_mixed_never_silently_wrong(rng):
     xd = np.asarray(res.X.to_dense())
     good = np.allclose(xd, np.linalg.solve(a, b), atol=1e-6)
     assert good or not bool(res.converged)
+
+
+# ----------------------------------------- certified spectral stack (PR 2)
+
+def _herm(rng, n, dtype=np.float64):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    return (a + a.conj().T) / 2
+
+
+def _singular_herm(rng, n=16, k=5):
+    a = _herm(rng, n)
+    a[:, k] = 0.0
+    a[k, :] = 0.0                        # exactly singular, info = k+1
+    return a
+
+
+# a minimal covering sweep: every route and every new fault site at least
+# once.  Auto solves the stage-1 band directly (no chase, no secular
+# solve); QR adds the bulge chase; DC adds the chase AND the secular
+# equation — the remaining (route, site) pairs traverse code already
+# covered by one of these and are left out to keep tier-1 within budget
+@pytest.mark.parametrize("meth,site", [
+    (MethodEig.Auto, "post_stage1"),
+    (MethodEig.Auto, "post_backtransform"),
+    (MethodEig.QR, "post_chase"),
+    (MethodEig.DC, "post_secular"),
+])
+def test_heev_fault_detected(rng, meth, site):
+    # a fault at ANY spectral pipeline stage must be caught by the
+    # a-posteriori certificate — never a silently-wrong finite (w, Z).
+    # The secular solve only runs on merges of > LEAF-sized subproblems,
+    # so that site needs a larger matrix; count=8 because a corrupted
+    # slot can land on a deflated (inactive) entry
+    n, nb = (36, 6) if site == "post_secular" else (16, 4)
+    a = _herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site=site, kind="nan", seed=11,
+                                        count=8)):
+        w, Z, h = st.heev(A, {Option.ErrorPolicy: ErrorPolicy.Info,
+                              Option.MethodEig: meth,
+                              Option.UseFallbackSolver: False})
+    assert not bool(h.ok)
+    # (clean certification of every route is covered by test_heev.py)
+
+
+def test_heev_fault_raise_and_nan_policies(rng):
+    n, nb = 16, 4
+    A = st.HermitianMatrix.from_numpy(_herm(rng, n), nb)
+    plan = faults.FaultPlan(site="post_backtransform", kind="bitflip",
+                            seed=5, count=1)
+    with faults.inject(plan):
+        with pytest.raises(SlateNotConvergedError):
+            st.heev(A, {Option.UseFallbackSolver: False})
+    with faults.inject(plan):
+        w, Z = st.heev(A, {Option.ErrorPolicy: ErrorPolicy.Nan,
+                           Option.UseFallbackSolver: False})
+        assert not np.all(np.isfinite(np.asarray(w)))
+
+
+def test_heev_escalation_recovers_transient(rng):
+    # single-shot SDC at the stage-1 seam: the Auto attempt is corrupted,
+    # the certificate rejects it, and the DC retry (fault already spent)
+    # returns a certified decomposition
+    n, nb = 16, 4
+    a = _herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site="post_stage1", kind="bitflip",
+                                        seed=3, count=1, transient=True)):
+        w, Z = st.heev(A, {Option.UseFallbackSolver: True})
+    assert np.allclose(np.sort(np.asarray(w)), np.linalg.eigvalsh(a),
+                       atol=1e-8)
+
+
+def test_heev_escalation_dc_to_qr_persistent(rng):
+    # a PERSISTENT fault in the secular solve defeats every DC attempt,
+    # but the QR route has no secular equation — method escalation walks
+    # DC -> QR and certifies there (n > LEAF so the merge actually runs)
+    n, nb = 36, 6
+    a = _herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site="post_secular", kind="nan",
+                                        seed=7, count=8)):
+        w, Z, h = st.heev(A, {Option.ErrorPolicy: ErrorPolicy.Info,
+                              Option.MethodEig: MethodEig.DC,
+                              Option.UseFallbackSolver: True})
+    assert bool(h.ok)
+    assert np.allclose(np.sort(np.asarray(w)), np.linalg.eigvalsh(a),
+                       atol=1e-8)
+
+
+def test_stedc_fault_detected_and_raises(rng):
+    n = 36                               # > LEAF: the merge path runs
+    d = rng.standard_normal(n)
+    e = rng.standard_normal(n - 1)
+    plan = faults.FaultPlan(site="post_secular", kind="nan", seed=2, count=8)
+    with faults.inject(plan):
+        w, Z, h = st.stedc(d, e, opts={Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+    with faults.inject(plan):
+        with pytest.raises(SlateNotConvergedError):
+            st.stedc(d, e)
+    # (clean stedc certification is covered by test_stedc.py)
+
+
+@pytest.mark.parametrize("meth,site", [
+    (MethodSvd.Auto, "post_stage1"),
+    (MethodSvd.Auto, "post_backtransform"),
+    (MethodSvd.Bidiag, "post_chase"),
+])
+def test_svd_fault_detected(rng, meth, site):
+    m, n, nb = 20, 16, 4
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site=site, kind="nan", seed=13,
+                                        count=4)):
+        s, U, V, h = st.svd(A, {Option.ErrorPolicy: ErrorPolicy.Info,
+                                Option.MethodSvd: meth,
+                                Option.UseFallbackSolver: False})
+    assert not bool(h.ok)
+    # (clean certification of both routes is covered by test_svd.py)
+
+
+def test_svd_escalation_recovers_transient(rng):
+    m, n, nb = 20, 16, 4
+    a = rng.standard_normal((m, n))
+    A = st.Matrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site="post_stage1", kind="bitflip",
+                                        seed=17, count=1, transient=True)):
+        s, U, V = st.svd(A, {Option.UseFallbackSolver: True})
+    assert np.allclose(np.asarray(s), np.linalg.svd(a, compute_uv=False),
+                       atol=1e-8)
+    with faults.inject(faults.FaultPlan(site="post_stage1", kind="nan",
+                                        seed=17, count=4)):
+        with pytest.raises(SlateNotConvergedError):
+            st.svd(A, {Option.UseFallbackSolver: False})
+
+
+def test_hetrf_singular_band_t(rng):
+    # exactly-singular Hermitian input: Aasen's band T is singular too —
+    # the eager contract is a typed error with the LAPACK-style info
+    n, nb = 16, 4
+    a = _singular_herm(rng, n, k=5)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    with pytest.raises(SlateSingularError) as ei:
+        st.hetrf(A)
+    assert ei.value.info >= 1
+    F, h = st.hetrf(A, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+    assert int(h.info) >= 1
+
+
+def test_hetrf_fault_detected_by_certificate(rng):
+    n, nb = 16, 4
+    a = _herm(rng, n)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    with faults.inject(faults.FaultPlan(site="post_stage1", kind="bitflip",
+                                        seed=19, count=1)):
+        F, h = st.hetrf(A, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+
+
+def test_hesv_falls_back_to_gesv(rng):
+    # hetrf's factor is corrupted at the stage-1 site; with the fallback
+    # enabled hesv escalates to a dense LU solve and still returns the
+    # right answer
+    n, nb = 16, 4
+    a = _herm(rng, n) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    with faults.inject(faults.FaultPlan(site="post_stage1", kind="nan",
+                                        seed=23, count=2)):
+        F, X = st.hesv(A, B, {Option.UseFallbackSolver: True})
+    assert np.allclose(X.to_numpy(), np.linalg.solve(a, b), atol=1e-8)
+
+
+def test_hesv_truly_singular_raises_after_fallback(rng):
+    n, nb = 16, 4
+    a = _singular_herm(rng, n)
+    b = np.ones((n, 1))
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    with pytest.raises(SlateSingularError):
+        st.hesv(A, B, {Option.UseFallbackSolver: True})
+
+
+def test_heev_nan_policy_keeps_static_fields(rng):
+    # ErrorPolicy.Nan must NaN-poison array leaves only: HEFactors carries
+    # a static int block size that hetrs needs for shape computation
+    n, nb = 16, 4
+    A = st.HermitianMatrix.from_numpy(_singular_herm(rng, n), nb)
+    F = st.hetrf(A, {Option.ErrorPolicy: ErrorPolicy.Nan})
+    assert isinstance(F.nb, int)
+    assert not np.all(np.isfinite(np.asarray(F.L)))
+
+
+def test_trtri_singular_contracts(rng):
+    n, nb = 16, 4
+    r = np.triu(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    r[6, 6] = 0.0
+    R = st.TriangularMatrix.from_numpy(r, nb, st.Uplo.Upper)
+    with pytest.raises(SlateSingularError) as ei:
+        st.trtri(R)
+    assert ei.value.info == 7            # 1-based index of the zero pivot
+    X, h = st.trtri(R, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert int(h.info) == 7 and not bool(h.ok)
+
+
+def test_getri_singular_factor_raises(rng):
+    n, nb = 16, 8
+    a = _singular_square(rng, n)
+    F, fh = st.getrf(st.Matrix.from_numpy(a, nb),
+                     {Option.ErrorPolicy: ErrorPolicy.Info})
+    with pytest.raises(SlateSingularError) as ei:
+        st.getri(F)
+    assert ei.value.info == int(fh.info)
+    X, h = st.getriOOP(st.Matrix.from_numpy(a, nb),
+                       {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert not bool(h.ok)
+
+
+def test_condest_poisoned_estimate_resolves_to_zero(rng):
+    # singular triangular factor poisons the Hager/Higham appliers; the
+    # guarded loop must resolve to rcond = 0 (the LAPACK convention) and
+    # flag it — never return NaN
+    n, nb = 20, 4
+    r = np.triu(rng.standard_normal((n, n))) + 4 * np.eye(n)
+    r[7, 7] = 0.0
+    R = st.TriangularMatrix.from_numpy(r, nb, st.Uplo.Upper)
+    rcond = st.trcondest(R)
+    assert float(rcond) == 0.0
+    rcond2, h = st.trcondest(R, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert float(rcond2) == 0.0 and bool(h.nonfinite)
+
+    # gecondest through a NaN LU factor (Nan-policy getrf of a singular
+    # matrix): same resolution
+    a = _singular_square(rng, n)
+    F = st.getrf(st.Matrix.from_numpy(a, nb),
+                 {Option.ErrorPolicy: ErrorPolicy.Nan})
+    anorm = np.abs(a).sum(axis=0).max()
+    rc, hg = st.gecondest(F, anorm, {Option.ErrorPolicy: ErrorPolicy.Info})
+    assert float(rc) == 0.0 and bool(hg.nonfinite)
+    assert np.isfinite(float(rc))
+
+
+def test_certify_clean_decompositions(rng):
+    # the certificates themselves: healthy on exact decompositions, not
+    # ok when handed a wrong eigenvector basis
+    from slate_tpu.robust.certify import certify_eig, certify_svd
+    n = 16
+    a = _herm(rng, n)
+    w, v = np.linalg.eigh(a)
+    h = certify_eig(jnp.asarray(a), jnp.asarray(w), jnp.asarray(v))
+    assert bool(h.ok)
+    vbad = np.roll(v, 1, axis=1)         # right values, wrong pairing
+    hb = certify_eig(jnp.asarray(a), jnp.asarray(w), jnp.asarray(vbad))
+    assert not bool(hb.ok)
+    m = 20
+    g = rng.standard_normal((m, n))
+    U, s, Vh = np.linalg.svd(g, full_matrices=False)
+    hs = certify_svd(jnp.asarray(g), jnp.asarray(s), jnp.asarray(U),
+                     jnp.asarray(Vh.conj().T))
+    assert bool(hs.ok)
 
 
 # ----------------------------------------------------------- option plumbing

@@ -2,14 +2,18 @@
 """Static check: every public factor/solve driver honors the robustness
 contract (docs/ROBUSTNESS.md).
 
-Two assertions, enforced by AST inspection (no imports, no jax, runs
+Three assertions, enforced by AST inspection (no imports, no jax, runs
 anywhere):
 
 1. every public driver function in the checked modules accepts an ``opts``
    parameter — Option.ErrorPolicy must be routable to every entry point;
 2. every checked module routes failures through the robust layer — it
-   imports from ``slate_tpu.robust`` (health / faults / recovery) at
-   module level or inside a function body.
+   imports from ``slate_tpu.robust`` (health / faults / recovery /
+   certify) at module level or inside a function body;
+3. every checked module actually RESOLVES a policy: it references the
+   health machinery (``finalize`` / ``finalize_flat`` / ``error_policy``
+   / ``HealthInfo``) somewhere in its body — an import alone is not a
+   contract.
 
 Runnable as a main (exit 1 + report on violation) and as pytest via
 tests/test_error_contracts.py.
@@ -25,13 +29,29 @@ REPO = Path(__file__).resolve().parent.parent
 DRIVERS = REPO / "slate_tpu" / "drivers"
 
 # the factor/solve surface: modules whose failures are numerical
-CHECKED_MODULES = ("lu.py", "cholesky.py", "band.py", "mixed.py", "qr.py")
+CHECKED_MODULES = (
+    "lu.py", "cholesky.py", "band.py", "mixed.py", "qr.py",
+    # the certified spectral stack
+    "heev.py", "svd.py", "stedc.py", "hetrf.py", "inverse.py",
+    "condest.py",
+)
 
 # public callables that are not drivers (constructors, helpers) or whose
 # contract predates opts (factor-object methods)
 EXEMPT = {
     "tree_flatten", "tree_unflatten", "lower", "upper",
+    # norm1est is an estimator primitive taking raw appliers, not a
+    # driver: its failure resolution (inf, never NaN) is value-level
+    "norm1est",
+    # *_info compute APIs always return (result, HealthInfo) — there is
+    # no policy to route, the caller resolves it
+    "stedc_info",
 }
+
+# names whose presence shows the module resolves ErrorPolicy through the
+# health layer rather than merely importing it
+HEALTH_NAMES = {"finalize", "finalize_flat", "error_policy", "HealthInfo",
+                "from_pivots", "from_result"}
 
 
 def _public_functions(tree: ast.Module):
@@ -60,6 +80,17 @@ def _imports_robust(tree: ast.Module) -> bool:
     return False
 
 
+def _references_health(tree: ast.Module) -> bool:
+    """True when the module calls into the health machinery — a Name or
+    Attribute access of one of HEALTH_NAMES anywhere in the body."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in HEALTH_NAMES:
+            return True
+        if isinstance(node, ast.Name) and node.id in HEALTH_NAMES:
+            return True
+    return False
+
+
 def check() -> list[str]:
     problems = []
     for name in CHECKED_MODULES:
@@ -73,6 +104,11 @@ def check() -> list[str]:
                 f"{name}: does not import the robust layer "
                 f"(health/faults/recovery) — failures are not routed "
                 f"through Option.ErrorPolicy")
+        elif not _references_health(tree):
+            problems.append(
+                f"{name}: imports the robust layer but never touches the "
+                f"health machinery (finalize/error_policy/HealthInfo) — "
+                f"no policy is resolved")
         for fn in _public_functions(tree):
             if fn.name in EXEMPT:
                 continue
