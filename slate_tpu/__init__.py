@@ -16,7 +16,8 @@ from .version import __version__, id, version  # noqa: F401
 from .types import Diag, Layout, Norm, Op, Side, TileKind, Uplo  # noqa: F401
 from .options import (  # noqa: F401
     ErrorPolicy, GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm,
-    MethodHemm, MethodLU, MethodSvd, MethodTrsm, NormScope, Option, Target,
+    MethodHemm, MethodLU, MethodSvd, MethodTrsm, NormScope, Option,
+    Speculate, Target,
 )
 from .exceptions import (  # noqa: F401
     SlateError, SlateNotConvergedError, SlateNotPositiveDefiniteError,
@@ -41,8 +42,8 @@ from .drivers.auxiliary import (  # noqa: F401
 from .drivers.cholesky import posv, potrf, potri, potrs  # noqa: F401
 from .drivers.inverse import trtri, trtrm  # noqa: F401
 from .drivers.lu import (  # noqa: F401
-    LUFactors, gesv, gesv_nopiv, getrf, getrf_nopiv, getrf_tntpiv, getri,
-    getriOOP, getrs,
+    LUFactors, RBTFactors, gesv, gesv_nopiv, getrf, getrf_nopiv, getrf_rbt,
+    getrf_tntpiv, getri, getriOOP, getrs,
 )
 from .drivers.qr import (  # noqa: F401
     CAQRFactors, LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,

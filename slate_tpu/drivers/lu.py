@@ -25,8 +25,8 @@ from ..core.matrix import Matrix, TriangularMatrix
 from ..core.storage import TileStorage
 from ..exceptions import SlateSingularError, slate_error
 from ..ops.elementwise import entry_mask
-from ..options import (MethodLU, Option, Options, Target, get_option,
-                       resolve_target, select_lu_method)
+from ..options import (ErrorPolicy, MethodLU, Option, Options, Target,
+                       get_option, resolve_target, select_lu_method)
 from ..parallel.dist_lu import dist_getrf
 from ..robust import faults
 from ..robust import health as _health
@@ -136,6 +136,98 @@ def getrf_tntpiv(A: Matrix, opts: Options | None = None) -> LUFactors:
     return _getrf(A, opts, "tntpiv")
 
 
+@jax.tree_util.register_pytree_node_class
+class RBTFactors:
+    """Factors of the butterfly-preconditioned pivot-free LU (getrf_rbt):
+    ``F`` is the NoPiv LUFactors of the TRANSFORMED padded matrix
+    A~ = U^T diag(A, I_pad) V, ``u``/``v`` the two depth-2 butterflies
+    (internal/rbt.py level tuples) and ``n`` the logical (unpadded) size.
+    getrs dispatches on this type: x = V (A~^-1 (U^T [b; 0]))[:n]."""
+
+    def __init__(self, F: LUFactors, u, v, n: int):
+        self.F = F
+        self.u = u
+        self.v = v
+        self.n = n
+
+    def tree_flatten(self):
+        return (self.F, self.u, self.v), (self.n,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux[0])
+
+    def __repr__(self):
+        return (f"RBTFactors(n={self.n}, padded={self.F.LU.m}, "
+                f"depth={len(self.u)})")
+
+
+# host-static butterfly seed: the transform is a preconditioner, not a
+# security primitive — determinism (bit-reproducible factors, replayable
+# fault tests) is worth more than per-call entropy
+_RBT_SEED = 0x5B17
+
+
+def _info(opts: Options | None) -> dict:
+    o = dict(opts or {})
+    o[Option.ErrorPolicy] = ErrorPolicy.Info
+    return o
+
+
+@annotate("slate.getrf_rbt")
+def getrf_rbt(A: Matrix, opts: Options | None = None):
+    """Butterfly-preconditioned pivot-free LU (PRBT: Parker '95, Baboulin
+    et al. '13): A~ = U^T diag(A, I_pad) V with depth-2 recursive random
+    butterflies (internal/rbt.py, O(n^2) elementwise), then
+    :func:`getrf_nopiv` on A~ — panels are pure MXU gemms against
+    triangular inverses, no pivot hunt.  Returns :class:`RBTFactors`.
+
+    This is the mechanism of the gesv speculative fast path; the policy
+    (Option.Speculate resolution, iterative refinement, residual
+    certification, escalation to pivoted LU on a failed certificate)
+    lives in robust/recovery.py.  Health: the NoPiv factor's pivot/growth
+    record over the TRANSFORMED matrix.
+
+    Mesh target: the two-sided transform is applied on the block-cyclic
+    storage via all-gathered row/column strips (parallel/dist_lu.py
+    dist_rbt_two_sided) when the padded size is butterfly-divisible;
+    otherwise falls back to the dense single-device transform."""
+    from ..internal import rbt
+    slate_error(A.m == A.n, "getrf_rbt: square matrices (gesv path)")
+    n, nb = A.m, A.nb
+    target = resolve_target(opts, A)
+    o = _info(opts)
+    if target is Target.mesh and A.grid.mesh is not None:
+        from ..parallel.dist_lu import dist_rbt_two_sided
+        An = as_root_general(A, nb, nb, grid=A.grid)
+        st = An.storage
+        m_pad = st.Mt * nb
+        if m_pad % (1 << rbt.DEFAULT_DEPTH) == 0:
+            u = rbt.generate(m_pad, seed=_RBT_SEED, dtype=A.dtype)
+            v = rbt.generate(m_pad, seed=_RBT_SEED + 1, dtype=A.dtype)
+            data = faults.maybe_corrupt("input", st.data)
+            data = dist_rbt_two_sided(data, u, v, A.grid, n)
+            data = faults.maybe_corrupt("post_rbt", data)
+            At = Matrix(TileStorage(data, m_pad, m_pad, nb, nb, st.grid))
+            Fi, fh = getrf_nopiv(At, o)
+            return _health.finalize("getrf_rbt", RBTFactors(Fi, u, v, n),
+                                    fh, opts, _singular("getrf_rbt"))
+    nt = rbt.padded_size(n)
+    ad = faults.maybe_corrupt("input", A.to_dense())
+    abar = jnp.zeros((nt, nt), ad.dtype).at[:n, :n].set(ad)
+    if nt > n:
+        r = jnp.arange(n, nt)
+        abar = abar.at[r, r].set(1)
+    u = rbt.generate(nt, seed=_RBT_SEED, dtype=ad.dtype)
+    v = rbt.generate(nt, seed=_RBT_SEED + 1, dtype=ad.dtype)
+    at = rbt.transform(abar, u, v)
+    at = faults.maybe_corrupt("post_rbt", at)
+    At = Matrix(TileStorage.from_dense(at, nb, nb, A.grid))
+    Fi, fh = getrf_nopiv(At, o)
+    return _health.finalize("getrf_rbt", RBTFactors(Fi, u, v, n), fh,
+                            opts, _singular("getrf_rbt"))
+
+
 def _lu_health(factor_arr, minpiv, minidx, amax):
     """Assemble the LU HealthInfo: pivot record from the panel min-pivot
     trace + whole-factor finiteness + pivot-growth ratio."""
@@ -202,13 +294,34 @@ def _singular(name: str):
         f"({h.describe()})", info=int(h.info))
 
 
+def _getrs_rbt(F: RBTFactors, B, opts: Options | None) -> Matrix:
+    """getrs body for RBT factors: the RAW transformed solve
+    x = V (A~^-1 (U^T [b; 0]))[:n] — no refinement, no certification
+    (those belong to the speculative gesv seam, robust/recovery.py).
+    B is skinny, so the butterfly applies on the dense RHS are O(n nrhs)
+    and mesh-safe; the inner triangular sweeps ride the tiled solve."""
+    from ..internal import rbt
+    slate_error(F.n == B.m, "getrs: dims")
+    nt = F.F.LU.m
+    bd = B.to_dense()
+    bbar = jnp.zeros((nt, bd.shape[1]), bd.dtype).at[: F.n].set(bd)
+    yt = rbt.apply_left_t(F.u, bbar)
+    Yt = Matrix(TileStorage.from_dense(yt, F.F.LU.nb, B.nb, B.grid))
+    Z = getrs(F.F, Yt, opts)
+    xbar = rbt.apply_left(F.v, Z.to_dense())
+    return Matrix(TileStorage.from_dense(xbar[: F.n], B.mb, B.nb, B.grid))
+
+
 @annotate("slate.getrs")
 def getrs(F: LUFactors, B, opts: Options | None = None) -> Matrix:
     """Solve with LU factors: X = U^-1 L^-1 B[perm] (ref: src/getrs.cc).
+    :class:`RBTFactors` dispatch to the butterfly transform sandwich.
 
     On the mesh the pivot application is sharded (dist_permute_rows —
     each rank holds a 1/q column strip, never a replicated dense B)."""
     from ..parallel.dist_lu import dist_permute_rows
+    if isinstance(F, RBTFactors):
+        return _getrs_rbt(F, B, opts)
     slate_error(F.LU.m == B.m, "getrs: dims")
     target = resolve_target(opts, B)
     if (target is Target.mesh and B.grid.mesh is not None
@@ -234,7 +347,11 @@ def gesv(A: Matrix, B, opts: Options | None = None):
     """Solve A X = B via LU (ref: src/gesv.cc; MethodLU dispatch).
     Returns (LUFactors, X); with Option.UseFallbackSolver an eager call
     escalates pivoting (NoPiv -> PartialPiv -> CALU) on unhealthy
-    factors — see robust/recovery.py and docs/ROBUSTNESS.md."""
+    factors.  Under ``Option.Speculate = on`` the first attempt is the
+    RBT-preconditioned pivot-free fast path (:func:`getrf_rbt` + 2 steps
+    of iterative refinement), certified by its relative residual; only a
+    failed certificate escalates to the pivoted chain — see
+    robust/recovery.py and docs/ROBUSTNESS.md."""
     from ..robust.recovery import gesv_with_recovery
     return gesv_with_recovery(A, B, opts)
 

@@ -35,7 +35,8 @@ from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
-from ..options import ErrorPolicy, Option, Options, get_option
+from ..options import (ErrorPolicy, Option, Options, get_option,
+                       resolve_speculate)
 from ..robust import health as _health
 from ..robust.health import HealthInfo
 from ..robust.recovery import bounded_retry
@@ -148,10 +149,20 @@ def _finish_mixed(x, it, h, fallback, opts):
 @annotate("slate.gesv_mixed")
 def gesv_mixed(A: Matrix, B, opts: Options | None = None) -> MixedResult:
     """LU in low precision + IR to working precision
-    (ref: src/gesv_mixed.cc)."""
+    (ref: src/gesv_mixed.cc).
+
+    ``Option.Speculate = on`` (resolved once here) swaps the low-precision
+    factor for the RBT-preconditioned NoPiv fast path (lu.getrf_rbt): the
+    IR loop already certifies the solve against the working-precision A,
+    so a bad NoPiv factor reads as non-convergence and the existing
+    full-precision fallback engages — no extra certificate needed."""
     lo = lower_precision(A.dtype)
     Alo = _cast_matrix(A, lo)
-    F, fh = getrf(Alo, _info_opts(opts))
+    if resolve_speculate(opts):
+        from .lu import getrf_rbt
+        F, fh = getrf_rbt(Alo, _info_opts(opts))
+    else:
+        F, fh = getrf(Alo, _info_opts(opts))
 
     def solve_lo(R):
         return _cast_matrix(getrs(F, _cast_matrix(R, lo), opts), A.dtype)

@@ -30,10 +30,8 @@ from ..core.storage import TileStorage
 from ..exceptions import SlateNotPositiveDefiniteError, slate_error
 from ..internal.qr import (apply_q_left, apply_q_right,
                            householder_panel_blocked)
-from ..options import (ErrorPolicy, MethodGels, Option, Options, Target,
-                       get_option, resolve_target, select_gels_method)
+from ..options import ErrorPolicy, Option, Options, Target, resolve_target
 from ..robust import health as _health
-from ..robust.recovery import bounded_retry
 from ..types import Op, Side, Uplo, is_complex
 from ..util.trace import annotate
 from .blas3 import _dense_to_like, _side, gemm, herk, trsm
@@ -297,14 +295,42 @@ def cholqr(A: Matrix, opts: Options | None = None):
     return _health.finalize("cholqr", (Q, R), h, opts, _gram_exc("cholqr"))
 
 
-def _gels_cholqr_attempt(A: Matrix, B, opts: Options | None):
+def _gels_cholqr_attempt(A: Matrix, B, opts: Options | None, *,
+                         refine: int = 0, certify: bool = False):
     """One semi-normal-equations solve under ErrorPolicy.Info; health
-    merges the Gram factor's record with the solution's finiteness."""
+    merges the Gram factor's record with the solution's finiteness.
+
+    ``refine`` adds that many corrected-semi-normal-equations sweeps
+    (Björck's CSNE: dx from A^H r through the same Gram factor), and
+    ``certify`` merges an a-posteriori normal-equations certificate
+    (robust/certify.certify_lstsq) — together these make the attempt the
+    speculative gels fast path (robust/recovery.gels_with_recovery)."""
     L, fh = potrf(_gram(A, opts), _info_opts(opts))
-    Z = gemm(1.0, A.conj_transpose(), B, 0.0, None, opts)   # A^H b
-    Y = trsm(Side.Left, 1.0, L, Z, opts)
-    X = trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
-    return X, _health.merge(fh, _health.from_result(X.storage.data))
+
+    def sne(Rhs):
+        Z = gemm(1.0, A.conj_transpose(), Rhs, 0.0, None, opts)  # A^H rhs
+        Y = trsm(Side.Left, 1.0, L, Z, opts)
+        return trsm(Side.Left, 1.0, L.conj_transpose(), Y, opts)
+
+    X = sne(B)
+    h = _health.merge(fh, _health.from_result(X.storage.data))
+    if refine or certify:
+        from ..robust import certify as _certify
+        from ..types import Norm
+        from . import auxiliary as _aux
+        for _ in range(refine):
+            R = gemm(-1.0, A, X, 1.0, B, opts)        # r = B - A X
+            X = _aux.add(1.0, sne(R), 1.0, X)
+        if certify:
+            R = gemm(-1.0, A, X, 1.0, B, opts)
+            Rn = gemm(1.0, A.conj_transpose(), R, 0.0, None, opts)
+            anorm = _aux.norm(Norm.Fro, A)
+            cert = _certify.certify_lstsq(
+                anorm, X.to_dense(), B.to_dense(), Rn.to_dense(),
+                tol=_certify.tolerance(A.dtype, max(A.m, A.n)))
+            h = _health.merge(h, cert._replace(iters=jnp.asarray(
+                refine, jnp.int32)))
+    return X, h
 
 
 @annotate("slate.gels_cholqr")
@@ -351,20 +377,15 @@ def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
     With Option.UseFallbackSolver an eager CholQR attempt whose Gram
     matrix fails Cholesky (rank-deficient / squared-conditioning) retries
     once via Householder QR — the bounded_retry policy shared with
-    gesv/posv (robust/recovery.py, docs/ROBUSTNESS.md).
+    gesv/posv (robust/recovery.py, docs/ROBUSTNESS.md).  Under
+    ``Option.Speculate = on`` the CholQR2 fast path runs FIRST for any
+    m >= n shape, refined and certified a-posteriori, with the same QR
+    escalation on a failed certificate (gels_with_recovery).
     """
     m, n = A.m, A.n
     if m >= n:
-        meth = select_gels_method(opts, m, n)
-        if meth is MethodGels.CholQR:
-            X, h = _gels_cholqr_attempt(A, B, opts)
-            fallbacks = []
-            if get_option(opts, Option.UseFallbackSolver):
-                fallbacks = [lambda: _gels_qr_attempt(A, B, opts)]
-            X, h, _ = bounded_retry((X, h), fallbacks, dtype=A.dtype,
-                                    max_retries=1)
-            return _health.finalize("gels", X, h, opts, _gram_exc("gels"))
-        return gels_qr(A, B, opts)
+        from ..robust.recovery import gels_with_recovery
+        return gels_with_recovery(A, B, opts)
     # minimum norm: A = L Q (L m x m lower), x = Q^H (L^-1 b)
     F = gelqf(A, opts)
     packed = F.F.QR.to_dense()               # QR of A^H: [n, m]

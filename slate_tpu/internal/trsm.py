@@ -99,17 +99,39 @@ def _diag_tiles(ad, K: int, nb: int):
     return ad.reshape(K, nb, K, nb)[i, :, i, :]
 
 
+def _pad_tri(ad, nb: int):
+    """Identity-augment a triangular [n, n] up to the next multiple of nb.
+
+    blockdiag(A, I) is triangular whichever triangle A lives in (the pad's
+    off-diagonal blocks are zero) and the identity diagonal is invariant
+    under transpose/conjugate, so padding BEFORE the op is exact:
+    solving against blockdiag(op(A), I) with zero-padded B rows/cols
+    yields the unpadded solution in the leading n slice."""
+    n = ad.shape[0]
+    n2 = -(-n // nb) * nb
+    if n2 == n:
+        return ad, n
+    r = jnp.arange(n, n2)
+    return (jnp.zeros((n2, n2), ad.dtype).at[:n, :n].set(ad)
+            .at[r, r].set(1)), n
+
+
 def trsm_left_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
                       unit: bool, nb: int):
-    """Solve op(A) X = B, A triangular [n, n] with n a multiple of nb, by
-    block substitution with ALL diagonal blocks inverted in one batched
-    log-depth pass (tri_inv_lower) — each step is then two MXU gemms.
+    """Solve op(A) X = B, A triangular [n, n], by block substitution with
+    ALL diagonal blocks inverted in one batched log-depth pass
+    (tri_inv_lower) — each step is then two MXU gemms.  A ragged n (not a
+    multiple of nb) is identity-augmented to the next block boundary
+    (exact; see _pad_tri).
 
     XLA's monolithic triangular_solve runs a per-column While loop
     (measured 4.1 TFLOP/s on [16384, 256], docs/ceiling.jsonl); this is
     the reference's work_trsm block sweep (ref: work/work_trsm.cc)
     reshaped so every op is a matmul."""
+    ad, n0 = _pad_tri(ad, nb)
     n = ad.shape[0]
+    if n > n0:
+        bd = jnp.zeros((n, bd.shape[1]), bd.dtype).at[:n0].set(bd)
     K = n // nb
     a_op = jnp.conj(ad) if conj else ad
     if trans:
@@ -132,14 +154,17 @@ def trsm_left_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
             x_done = jnp.concatenate(xs[k + 1:], axis=0)
             acc = acc - a_op[k0:k1, k1:] @ x_done
         xs[k] = dinv[k] @ acc
-    return jnp.concatenate(xs, axis=0)
+    return jnp.concatenate(xs, axis=0)[:n0]
 
 
 def trsm_right_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
                        unit: bool, nb: int):
     """Solve X op(A) = B by block substitution over block columns (right
-    side twin of trsm_left_blocked)."""
+    side twin of trsm_left_blocked; ragged n identity-augmented)."""
+    ad, n0 = _pad_tri(ad, nb)
     n = ad.shape[0]
+    if n > n0:
+        bd = jnp.zeros((bd.shape[0], n), bd.dtype).at[:, :n0].set(bd)
     K = n // nb
     a_op = jnp.conj(ad) if conj else ad
     if trans:
@@ -164,4 +189,4 @@ def trsm_right_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
             x_done = jnp.concatenate(xs[:k], axis=1)
             acc = acc - x_done @ a_op[:k0, k0:k1]
         xs[k] = acc @ dinv[k]
-    return jnp.concatenate(xs, axis=1)
+    return jnp.concatenate(xs, axis=1)[:, :n0]

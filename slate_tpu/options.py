@@ -56,6 +56,28 @@ class ErrorPolicy(enum.Enum):
     Info = "info"
 
 
+class Speculate(enum.Enum):
+    """Speculate-then-certify execution mode (docs/ROBUSTNESS.md).
+
+    The robust layer's escalation ladders run *backwards* by default: try
+    the requested (safe) method, escalate on failure.  ``Speculate.On``
+    runs them *forwards* as a performance feature: the solver first tries
+    the cheapest pivot/structure-free method in its family (gesv: RBT
+    NoPiv LU + refinement; gels: CholQR2 semi-normal equations; hesv:
+    Cholesky), certifies the result a-posteriori (residual + growth folded
+    into HealthInfo), and only a failed certificate escalates to the
+    conventional method — eagerly, via the same bounded_retry policy.
+
+    Auto    currently Off (the heuristic seam for future auto-enabling)
+    Off     conventional method order
+    On      speculative fast path first, certified
+    """
+
+    Auto = "auto"
+    Off = "off"
+    On = "on"
+
+
 class Option(enum.Enum):
     """Option keys (ref: enums.hh:69-101)."""
 
@@ -67,6 +89,7 @@ class Option(enum.Enum):
     Tolerance = "tolerance"
     Target = "target"
     ErrorPolicy = "error_policy"
+    Speculate = "speculate"
     UseFallbackSolver = "use_fallback_solver"
     PivotThreshold = "pivot_threshold"
     MethodGemm = "method_gemm"
@@ -184,6 +207,7 @@ _DEFAULTS = {
     Option.Tolerance: None,
     Option.Target: Target.auto,
     Option.ErrorPolicy: ErrorPolicy.Raise,
+    Option.Speculate: Speculate.Auto,
     Option.UseFallbackSolver: True,
     Option.PivotThreshold: 1.0,
     Option.MethodGemm: MethodGemm.Auto,
@@ -208,7 +232,8 @@ _UNSET = object()
 # options whose values have a canonical enum: string spellings are accepted
 # uniformly ({Option.Target: "mesh"}, {Option.ErrorPolicy: "info"}) and
 # coerced here so every consumer sees the enum.
-_ENUM_VALUED = {Option.Target: Target, Option.ErrorPolicy: ErrorPolicy}
+_ENUM_VALUED = {Option.Target: Target, Option.ErrorPolicy: ErrorPolicy,
+                Option.Speculate: Speculate}
 
 
 def get_option(opts: Options | None, key: Option,
@@ -239,6 +264,15 @@ def resolve_target(opts: Options | None, matrix) -> Target:
     if grid is not None and grid.size > 1:
         return Target.mesh
     return Target.single
+
+
+def resolve_speculate(opts: Options | None) -> bool:
+    """Resolve Option.Speculate ONCE at a driver boundary (the same
+    discipline as ErrorPolicy / health.error_policy): True only for an
+    explicit ``Speculate.On`` — ``Auto`` currently maps to Off so the
+    default solver behavior is unchanged.  Every consumer below the
+    boundary receives the decision, never the knob."""
+    return get_option(opts, Option.Speculate) is Speculate.On
 
 
 def select_gemm_method(opts: Options | None, nt: int) -> MethodGemm:

@@ -291,6 +291,63 @@ def dist_permute_rows(b_data, perm, grid: Grid):
     return fn(b_data, perm_pad)
 
 
+def dist_rbt_two_sided(data, u_levels, v_levels, grid: Grid, n: int):
+    """Sharded two-sided butterfly transform U^T diag(A, I_pad) V on
+    block-cyclic storage (drivers/lu.py getrf_rbt mesh path; butterflies
+    from internal/rbt.py).
+
+    Each rank all-gathers its tile-COLUMN strip along the p axis to apply
+    the row butterflies in global element order, then its tile-ROW strip
+    along the q axis for the column butterflies — memory is a 1/q (then
+    1/p) slice of the matrix, never a replicated dense copy (the
+    dist_permute_rows discipline).  The butterfly diagonals are host-seeded
+    trace constants replicated on every rank, so each application is pure
+    elementwise work on the gathered strip: O(d m^2/q) flops per rank, no
+    matmuls, and only the two all_gathers as communication."""
+    from ..internal import rbt
+    p, q = grid.p, grid.q
+    mtl = data.shape[0] // p
+    ntl = data.shape[1] // q
+    nb = data.shape[-1]
+    m_pad = p * mtl * nb
+    # identity-augment the pad diagonal: the transform must act on
+    # diag(A, I), not diag(A, 0) (pads are zero by the canonical invariant)
+    if m_pad > n:
+        g = jnp.arange(n, m_pad)
+        data = data.at[g // nb, g // nb, g % nb, g % nb].set(1)
+
+    def local(a_loc, lu, lv):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        gidx = jnp.arange(m_pad)
+        # strip index of global element row/col g (see dist_permute_rows)
+        si = ((gidx // nb % p) * (mtl * nb) + (gidx // nb // p) * nb
+              + gidx % nb)
+        sj = ((gidx // nb % q) * (ntl * nb) + (gidx // nb // q) * nb
+              + gidx % nb)
+        # row pass: U^T @ (.) on the full column strip in global row order
+        allp = lax.all_gather(a_loc, AXIS_P)      # [p, mtl, ntl, nb, nb]
+        strip = allp.transpose(0, 1, 3, 2, 4).reshape(m_pad, ntl, nb)
+        ordered = rbt.apply_axis(lu, strip[si], "t", 0)
+        gr = ((r + p * jnp.arange(mtl))[:, None] * nb
+              + jnp.arange(nb)[None, :]).reshape(-1)
+        a_loc = ordered[gr].reshape(mtl, nb, ntl, nb).transpose(0, 2, 1, 3)
+        # column pass: (.) @ V on the full row strip in global column order
+        allq = lax.all_gather(a_loc, AXIS_Q)      # [q, mtl, ntl, nb, nb]
+        cstrip = allq.transpose(1, 3, 0, 2, 4).reshape(mtl, nb,
+                                                       q * ntl * nb)
+        cordered = rbt.apply_axis(lv, cstrip[:, :, sj], "t", 2)
+        gc = ((c + q * jnp.arange(ntl))[:, None] * nb
+              + jnp.arange(nb)[None, :]).reshape(-1)
+        return cordered[:, :, gc].reshape(mtl, nb, ntl, nb).transpose(
+            0, 2, 1, 3)
+
+    spec = P(AXIS_P, AXIS_Q, None, None)
+    fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, P(), P()),
+                       out_specs=spec)
+    return fn(data, u_levels, v_levels)
+
+
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
                ib: int = 16, sb: int | None = None, tau: float = 1.0,
                mpt: int = 4, depth: int = 2):

@@ -1,4 +1,5 @@
-"""A-posteriori certification of spectral results (jit-compatible).
+"""A-posteriori certification of spectral and speculative results
+(jit-compatible).
 
 The factor/solve drivers can read failure off their own pivots; the
 spectral drivers cannot — a NaN-poisoned bulge chase, a non-converged
@@ -119,6 +120,74 @@ def certify_svd(a, s, u, v, *, tol: float | None = None) \
         min_pivot_index=worst,
         growth=ratio.astype(h.growth.dtype),
         converged=finite & (resid <= tol) & (ou <= tol) & (ov <= tol),
+    )
+
+
+def certify_solve(anorm, x, b, r, *, tol: float | None = None,
+                  iters: int = 0) -> _health.HealthInfo:
+    """Certificate for a linear solve A X = B from its residual
+    ``r = B - A X`` (computed by the caller with the mesh-aware gemm
+    driver so A is never densified here): relative residual
+
+        ||r||_F / (||A||_F ||X||_F + ||B||_F)
+
+    vs :func:`tolerance` at n = X rows.  This is the speculation gate of
+    the RBT fast path (robust/recovery.py): a NoPiv factorization of the
+    butterfly-transformed matrix that went numerically wrong — or a
+    bit-flipped transform (the ``post_rbt`` fault site) — produces a
+    finite X whose residual overshoots the tolerance by orders of
+    magnitude.  ``anorm`` is a (possibly traced) scalar ||A||_F; ``iters``
+    records refinement steps into the health."""
+    x = jnp.asarray(x)
+    b = jnp.asarray(b)
+    r = jnp.asarray(r)
+    if tol is None:
+        tol = tolerance(x.dtype, x.shape[0])
+    col = jnp.sum(jnp.abs(r) * jnp.abs(r), axis=0)
+    worst = jnp.argmax(col).astype(jnp.int32)
+    denom = (jnp.asarray(anorm) * _fro(x) + _fro(b))
+    tiny = jnp.asarray(jnp.finfo(col.dtype).tiny, col.dtype)
+    ratio = _fro(r) / jnp.maximum(denom, tiny)
+    finite = jnp.all(jnp.isfinite(jnp.abs(x)))
+    h = _health.healthy(x.dtype)
+    return h._replace(
+        nonfinite=~finite,
+        min_pivot_index=worst,
+        growth=ratio.astype(h.growth.dtype),
+        iters=jnp.asarray(iters, jnp.int32),
+        converged=finite & (ratio <= tol),
+    )
+
+
+def certify_lstsq(anorm, x, b, rn, *, tol: float | None = None) \
+        -> _health.HealthInfo:
+    """Certificate for a least-squares solve min ||A X - B|| from its
+    normal-equations residual ``rn = A^H (B - A X)`` (which is ~0 at the
+    true minimizer even when the plain residual is large): relative ratio
+
+        ||A^H r||_F / (||A||_F^2 ||X||_F + ||A||_F ||B||_F)
+
+    vs :func:`tolerance` at max(m, n) — pass ``tol`` explicitly to
+    calibrate.  Gates the speculative CholQR2 gels path the same way
+    :func:`certify_solve` gates the RBT gesv path."""
+    x = jnp.asarray(x)
+    b = jnp.asarray(b)
+    rn = jnp.asarray(rn)
+    if tol is None:
+        tol = tolerance(x.dtype, max(x.shape[0], b.shape[0]))
+    col = jnp.sum(jnp.abs(rn) * jnp.abs(rn), axis=0)
+    worst = jnp.argmax(col).astype(jnp.int32)
+    an = jnp.asarray(anorm)
+    denom = an * an * _fro(x) + an * _fro(b)
+    tiny = jnp.asarray(jnp.finfo(col.dtype).tiny, col.dtype)
+    ratio = _fro(rn) / jnp.maximum(denom, tiny)
+    finite = jnp.all(jnp.isfinite(jnp.abs(x)))
+    h = _health.healthy(x.dtype)
+    return h._replace(
+        nonfinite=~finite,
+        min_pivot_index=worst,
+        growth=ratio.astype(h.growth.dtype),
+        converged=finite & (ratio <= tol),
     )
 
 

@@ -28,6 +28,10 @@ Named sites (see docs/ROBUSTNESS.md):
 ``post_secular``   the secular-equation roots inside the stedc D&C merge
 ``post_backtransform`` the accumulated eigen/singular vectors after the
                    stage-1 back-transform (unmtr_he2hb / unmbr_ge2tb)
+``post_rbt``       the butterfly-transformed matrix U^T A V, before the
+                   speculative NoPiv factorization consumes it (a strike
+                   here yields a finite-but-wrong fast-path solve that
+                   only the a-posteriori residual certificate catches)
 =================  =====================================================
 
 Payloads: ``nan``, ``inf``, and ``bitflip`` — a high-exponent-bit flip
@@ -51,7 +55,8 @@ import jax
 import jax.numpy as jnp
 
 SITES = ("input", "post_panel", "post_collective", "solve",
-         "post_stage1", "post_chase", "post_secular", "post_backtransform")
+         "post_stage1", "post_chase", "post_secular", "post_backtransform",
+         "post_rbt")
 KINDS = ("nan", "inf", "bitflip")
 
 # flipping exponent bit 6 of an O(1) value: finite, wildly wrong

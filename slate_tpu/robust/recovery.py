@@ -24,6 +24,13 @@ the same shape everywhere a cheap method can fail on hard inputs:
 - :func:`hesv_with_recovery` — a singular band T (Aasen's tridiagonal
   factor has no pivoting to save it) falls back to plain LU ``gesv``
   on the densified Hermitian matrix.
+- Speculation (``Option.Speculate = on``, resolved once per driver
+  boundary like ErrorPolicy): the same ladders run FORWARDS as a
+  performance feature — gesv tries the RBT-preconditioned NoPiv fast
+  path (:func:`_rbt_attempt`), gels tries CholQR2 semi-normal equations
+  (:func:`gels_with_recovery`), hesv tries Cholesky first
+  (:func:`hesv_with_recovery`) — each attempt certified a-posteriori
+  (:mod:`certify`) so a wrong fast answer escalates instead of escaping.
 
 Escalation requires host control flow, so it engages only on EAGER calls;
 traced calls run the requested method once and surface health per
@@ -34,8 +41,10 @@ from __future__ import annotations
 
 from ..exceptions import (SlateNotConvergedError,
                           SlateNotPositiveDefiniteError, SlateSingularError)
-from ..options import (ErrorPolicy, MethodEig, MethodLU, MethodSvd, Option,
-                       Options, get_option, select_lu_method)
+from ..options import (ErrorPolicy, MethodEig, MethodGels, MethodLU,
+                       MethodSvd, Option, Options, get_option,
+                       resolve_speculate, select_gels_method,
+                       select_lu_method)
 from . import health as _h
 
 
@@ -99,22 +108,63 @@ def _lu_attempt(A, B, opts, method):
     return (F, X), h
 
 
+def _rbt_attempt(A, B, opts, ir_steps: int = 2):
+    """The speculative gesv fast path: RBT-preconditioned NoPiv LU
+    (drivers/lu.py getrf_rbt), ``ir_steps`` rounds of iterative refinement
+    in the ORIGINAL system, then an a-posteriori residual certificate
+    (certify.certify_solve) merged into the factor health — a wrong
+    fast-path solve (adversarial growth, a post_rbt bit flip) reads as
+    ``converged=False`` and escalates in gesv_with_recovery.
+
+    Fully traceable: the attempt itself is pure jnp + drivers; only the
+    escalation branch (bounded_retry) needs eager health."""
+    from ..drivers import auxiliary as _aux
+    from ..drivers import lu as _lu
+    from ..drivers.blas3 import gemm
+    from ..types import Norm
+    from . import certify as _certify
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    F, fh = _lu.getrf_rbt(A, o)
+    X = _lu.getrs(F, B, o)
+    for _ in range(ir_steps):
+        R = gemm(-1.0, A, X, 1.0, B, opts)         # r = B - A X, mesh-aware
+        X = _aux.add(1.0, _lu.getrs(F, R, o), 1.0, X)
+    R = gemm(-1.0, A, X, 1.0, B, opts)
+    anorm = _aux.norm(Norm.Fro, A)
+    cert = _certify.certify_solve(anorm, X.to_dense(), B.to_dense(),
+                                  R.to_dense(), iters=ir_steps)
+    return (F, X), _h.merge(fh, cert)
+
+
 def gesv_with_recovery(A, B, opts: Options | None = None):
     """gesv body with pivoting escalation (drivers/lu.py delegates here).
+
+    Default order is the safety ladder: requested method first, escalate
+    on unhealthy factors.  ``Option.Speculate = on`` (resolved ONCE here,
+    like ErrorPolicy) inverts it into a performance feature: the first
+    attempt is the certified RBT NoPiv fast path and the pivoted chain
+    only runs when the certificate fails — eagerly, as always.
 
     Return shape matches gesv's ErrorPolicy contract: ``(F, X)`` under
     Raise/Nan, ``(F, X, HealthInfo)`` under Info."""
     method = select_lu_method(opts)
+    speculate = resolve_speculate(opts)
     chain = _LU_CHAIN[method]
+    if speculate:
+        # the RBT attempt IS the NoPiv rung — escalation goes pivoted
+        fb_methods = tuple(m for m in chain if m is not MethodLU.NoPiv)
+        first = _rbt_attempt(A, B, opts)
+    else:
+        fb_methods = chain[1:]
+        first = _lu_attempt(A, B, opts, chain[0])
     if not get_option(opts, Option.UseFallbackSolver):
-        chain = chain[:1]
-    (F, X), h = _lu_attempt(A, B, opts, chain[0])
+        fb_methods = ()
     # bounded_retry demotes `converged` on growth beyond the limit: the raw
     # drivers keep growth out of .ok, the recovering solver does not.
     (F, X), h, _ = bounded_retry(
-        ((F, X), h),
-        [lambda m=m: _lu_attempt(A, B, opts, m) for m in chain[1:]],
-        dtype=A.dtype, max_retries=len(chain))
+        first,
+        [lambda m=m: _lu_attempt(A, B, opts, m) for m in fb_methods],
+        dtype=A.dtype, max_retries=max(len(fb_methods), 1))
     return _finalize_solve("gesv", F, X, h, opts, _singular_exc("gesv"))
 
 
@@ -129,23 +179,34 @@ def gesv_nopiv_raw(A, B, opts: Options | None = None):
 
 # ------------------------------------------------------------------ posv
 
+def _chol_attempt(A, B, opts):
+    """One potrf+potrs attempt under Info.  Shared between posv's primary
+    try and hesv's HPD speculation: an indefinite input NaN-fills the
+    Cholesky factor, which reads as ``nonfinite`` and falls through the
+    retry ladder — no extra certificate needed."""
+    from ..drivers import cholesky as _chol
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    L, fh = _chol.potrf(A, o)
+    X = _chol.potrs(L, B, o)
+    return (L, X), _h.merge(fh, _h.from_result(X.storage.data))
+
+
 def posv_with_recovery(A, B, opts: Options | None = None):
     """posv body with non-HPD fallback (drivers/cholesky.py delegates).
 
     On an eager non-HPD failure with Option.UseFallbackSolver set, retries
     the solve as Hermitian-indefinite (hesv), then as plain LU (gesv).
+    posv is already speculation-shaped — Cholesky (the cheapest factor)
+    first, certified by its own pivots — so Option.Speculate changes
+    nothing here; it reorders hesv (see hesv_with_recovery).
     The first returned element is the factor object of whichever method
     succeeded (TriangularMatrix / HEFactors / LUFactors)."""
-    from ..drivers import cholesky as _chol
-    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
-    L, fh = _chol.potrf(A, o)
-    X = _chol.potrs(L, B, o)
-    h = _h.merge(fh, _h.from_result(X.storage.data))
+    first = _chol_attempt(A, B, opts)
     fallbacks = []
     if get_option(opts, Option.UseFallbackSolver):
         fallbacks = [lambda: _hesv_attempt(A, B, opts),
                      lambda: _gesv_attempt(A, B, opts)]
-    (F, X), h, _ = bounded_retry(((L, X), h), fallbacks, dtype=A.dtype)
+    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype)
     return _finalize_solve(
         "posv", F, X, h, opts,
         lambda hh: SlateNotPositiveDefiniteError(
@@ -253,18 +314,68 @@ def hesv_with_recovery(A, B, opts: Options | None = None):
     beyond its band, so a singular T poisons the solve — fall back to
     densified LU ``gesv`` when ``Option.UseFallbackSolver`` is set.
 
+    ``Option.Speculate = on`` (resolved ONCE here) runs the posv ordering
+    forward as speculation: Cholesky first — the cheapest Hermitian
+    factorization, self-certifying through its pivots — with the Aasen
+    method as the guaranteed fallback for indefinite inputs, then
+    densified gesv.  The Aasen rung is always present when speculating
+    (the baseline contract: any Hermitian input hesv could solve before,
+    it still solves), gesv only with UseFallbackSolver.
+
     Return shape matches gesv's contract: ``(F, X)`` under Raise/Nan,
     ``(F, X, HealthInfo)`` under Info."""
     from ..drivers import hetrf as _he
-    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
-    F, fh = _he.hetrf(A, o)
-    X = _he.hetrs(F, B, o)
-    h = _h.merge(fh, _h.from_result(X.storage.data))
+
+    def aasen():
+        o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+        F, fh = _he.hetrf(A, o)
+        X = _he.hetrs(F, B, o)
+        return (F, X), _h.merge(fh, _h.from_result(X.storage.data))
+
+    use_fb = get_option(opts, Option.UseFallbackSolver)
+    if resolve_speculate(opts):
+        first = _chol_attempt(A, B, opts)
+        fallbacks = [aasen]
+        if use_fb:
+            fallbacks.append(lambda: _gesv_attempt(A, B, opts))
+    else:
+        first = aasen()
+        fallbacks = [lambda: _gesv_attempt(A, B, opts)] if use_fb else []
+    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype,
+                                 max_retries=max(len(fallbacks), 1))
+    return _finalize_solve("hesv", F, X, h, opts, _singular_exc("hesv"))
+
+
+# ------------------------------------------------------------------ gels
+
+def gels_with_recovery(A, B, opts: Options | None = None):
+    """gels (m >= n) body with CholQR2 speculation and QR fallback
+    (drivers/qr.py delegates here), unifying the previously ad-hoc
+    CholQR -> QR fallback under bounded_retry.
+
+    Method resolution (select_gels_method) picks CholQR for tall-skinny
+    problems; ``Option.Speculate = on`` (resolved ONCE here) forces the
+    CholQR2 semi-normal-equations fast path FIRST for any shape, with one
+    refinement sweep and an a-posteriori normal-equations certificate
+    (certify.certify_lstsq) merged into its health.  A failed certificate
+    — squaring the condition number lost too much, or the Gram matrix was
+    not numerically HPD — escalates to full Householder QR eagerly.
+
+    Return shape: ``X`` under Raise/Nan, ``(X, HealthInfo)`` under Info."""
+    from ..drivers import qr as _qr
+    speculate = resolve_speculate(opts)
+    method = select_gels_method(opts, A.m, A.n)
+    if speculate:
+        first = _qr._gels_cholqr_attempt(A, B, opts, refine=1, certify=True)
+    elif method is MethodGels.CholQR:
+        first = _qr._gels_cholqr_attempt(A, B, opts)
+    else:
+        return _qr.gels_qr(A, B, opts)
     fallbacks = []
     if get_option(opts, Option.UseFallbackSolver):
-        fallbacks = [lambda: _gesv_attempt(A, B, opts)]
-    (F, X), h, _ = bounded_retry(((F, X), h), fallbacks, dtype=A.dtype)
-    return _finalize_solve("hesv", F, X, h, opts, _singular_exc("hesv"))
+        fallbacks = [lambda: _qr._gels_qr_attempt(A, B, opts)]
+    X, h, _ = bounded_retry(first, fallbacks, dtype=A.dtype, max_retries=1)
+    return _h.finalize("gels", X, h, opts, _qr._gram_exc("gels"))
 
 
 # ------------------------------------------------------------------ shared

@@ -12,6 +12,17 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Before every backend_compile, jax walks the lowered MLIR module's first
+# ops through the Python bindings to pick an XLA logging verbosity
+# (compiler.use_detailed_logging).  Each op visit degrades as live MLIR
+# contexts accumulate over the session (~17 ms/op by the suite's tail vs
+# microseconds fresh), which made alphabetically-late test files measure
+# 4-5x slower in-suite than in isolation (stedc: 30 s vs 7 s).  Threshold
+# 0 classifies every module as "interesting" without walking any ops;
+# xla_detailed_logging only gates VLOG output, which the suite never
+# enables.
+os.environ.setdefault("JAX_COMPILER_DETAILED_LOGGING_MIN_OPS", "0")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

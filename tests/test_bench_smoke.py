@@ -1,0 +1,145 @@
+"""CPU smoke tests for bench.py: every metric function emits one parseable
+JSON line at toy sizes, and the SLATE_BENCH_BUDGET_S harness skips (never
+kills) metrics that would blow the budget — the whole run always exits 0
+with one line per metric (BENCH_r04 rc=1 / BENCH_r05 rc=124 regressions).
+"""
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    saved = sys.modules.get("bench")
+    sys.modules["bench"] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    if saved is not None:
+        sys.modules["bench"] = saved
+    else:
+        sys.modules.pop("bench", None)
+
+
+def _lines(capsys):
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    return [json.loads(ln) for ln in out]
+
+
+TOY = [
+    ("bench_gemm", dict(n=64, nb=32, iters=2)),
+    ("bench_posv", dict(n=64, nb=32, nrhs=4, iters=1)),
+    ("bench_gesv", dict(n=64, nb=32, nrhs=4, iters=1)),
+    ("bench_gesv_rbt", dict(n=64, nb=32, nrhs=4, iters=1)),
+    ("bench_geqrf", dict(m=96, n=32, nb=32, iters=1)),
+    ("bench_gels", dict(m=96, n=32, nb=32, nrhs=4, iters=1)),
+    ("bench_heev", dict(n=64, nb=32, iters=1)),
+    ("bench_svd", dict(n=64, nb=32, iters=1)),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", TOY, ids=[t[0] for t in TOY])
+def test_metric_emits_json(bench, capsys, name, kwargs):
+    getattr(bench, name)(**kwargs)
+    lines = _lines(capsys)
+    assert len(lines) == 1
+    line = lines[0]
+    assert line["unit"] == "GFLOP/s"
+    assert isinstance(line["value"], (int, float)) and line["value"] > 0
+    assert isinstance(line["vs_baseline"], (int, float))
+
+
+def test_step_lists_cover_every_metric(bench):
+    """Both step lists must include the RBT speculation metric and stay
+    callable (functions exist, kwargs are their signature's names)."""
+    import inspect
+    for steps in (bench.QUICK_STEPS, bench.FULL_STEPS):
+        names = [fn.__name__ for fn, _ in steps]
+        assert "bench_gesv_rbt" in names
+        for fn, kwargs in steps:
+            sig = inspect.signature(fn)
+            assert set(kwargs) == set(sig.parameters)
+
+
+def test_budget_preempts_slow_metric(bench, capsys):
+    """A metric that overruns the pool is SIGALRM-preempted and reported
+    as skipped; the harness moves on instead of hanging to rc=124."""
+
+    def sleepy():
+        time.sleep(30)
+
+    t0 = time.monotonic()
+    failures = bench._run_isolated([(sleepy, {})], budget_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5
+    assert failures == 0
+    lines = _lines(capsys)
+    assert len(lines) == 1
+    assert lines[0]["skipped"] is True
+    assert lines[0]["metric"] == "sleepy_skipped"
+    assert "preempted" in lines[0]["reason"]
+
+
+def test_budget_skips_up_front(bench, capsys, monkeypatch):
+    """When earlier metrics ate the whole pool, later ones emit a skipped
+    line up front — one JSON line per step, no matter what."""
+    t = [0.0]
+
+    def fake_clock():
+        t[0] += 40.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", fake_clock)
+    ran = []
+
+    def quick():
+        ran.append(1)
+
+    def never():
+        raise AssertionError("must be skipped before running")
+
+    # pool = 2 * 30 = 60s of fake time: quick runs (clock 80 > deadline
+    # 100? no: deadline = 40 + 60 = 100, check at 80), never is skipped
+    failures = bench._run_isolated([(quick, {}), (never, {})], budget_s=30)
+    assert failures == 0
+    assert ran == [1]
+    lines = _lines(capsys)
+    assert len(lines) == 1
+    assert lines[0]["skipped"] is True
+    assert lines[0]["metric"] == "never_skipped"
+    assert lines[0]["reason"] == "time budget exhausted"
+
+
+def test_no_budget_is_unlimited(bench, capsys):
+    ran = []
+    bench._run_isolated([(lambda: ran.append(1), {})], budget_s=None)
+    assert ran == [1]
+
+
+def test_failures_are_isolated_and_main_exits_zero(bench, capsys,
+                                                   monkeypatch):
+    """A raising metric emits an error line; main() still returns 0 (the
+    r04 regression was rc=1 after isolated failures)."""
+
+    def boom():
+        raise RuntimeError("synthetic")
+
+    ran = []
+    monkeypatch.setattr(bench, "QUICK", True)
+    monkeypatch.setattr(bench, "QUICK_STEPS",
+                        [(boom, {}), (lambda: ran.append(1), {})])
+    rc = bench.main()
+    assert rc == 0
+    assert ran == [1]
+    lines = _lines(capsys)
+    assert len(lines) == 1                # boom's error line; the lambda
+    assert lines[0]["metric"] == "boom_error"   # emits nothing itself
+    assert "synthetic" in lines[0]["error"]

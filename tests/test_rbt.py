@@ -1,0 +1,361 @@
+"""RBT / speculate-then-certify tests (CPU-exact, no accelerator needed).
+
+Covers: butterfly apply/unapply round trips at both precisions, the
+two-sided transform against a dense reference, gesv under Option.Speculate
+vs the pivoted oracle on well-conditioned AND adversarial inputs, the
+post_rbt fault site provably triggering escalation, the traced (jit)
+contract, and the gels/hesv speculation seams.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.internal import rbt
+from slate_tpu.robust import faults
+
+SPEC = {st.Option.Speculate: "on"}
+SPEC_INFO = {st.Option.Speculate: "on", st.Option.ErrorPolicy: "info"}
+
+
+def _tol(dtype):
+    return 200 * np.finfo(dtype).eps
+
+
+# ------------------------------------------------------------ mechanism
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("n", [8, 24])
+def test_apply_roundtrip(rng, dtype, n):
+    u = rbt.generate(n, seed=3, dtype=dtype)
+    x = rng.standard_normal((n, 5)).astype(dtype)
+    for fwd, inv in [("n", "inv"), ("t", "invt")]:
+        y = rbt.apply_axis(u, x, fwd)
+        back = np.asarray(rbt.apply_axis(u, y, inv))
+        np.testing.assert_allclose(back, x, rtol=_tol(dtype),
+                                   atol=_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_transform_untransform(rng, dtype):
+    n = 16
+    u = rbt.generate(n, seed=4, dtype=dtype)
+    v = rbt.generate(n, seed=5, dtype=dtype)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    at = rbt.transform(a, u, v)
+    back = np.asarray(rbt.untransform(at, u, v))
+    np.testing.assert_allclose(back, a, rtol=_tol(dtype), atol=_tol(dtype))
+
+
+def test_transform_matches_dense_butterfly(rng):
+    """The level representation multiplies out to W = L0 @ L1 exactly."""
+    n = 8
+    u = rbt.generate(n, seed=6, dtype=np.float64)
+
+    def dense_w(levels):
+        s = np.sqrt(0.5)
+        W = np.eye(n)
+        for lev, (r0, r1) in enumerate(levels):
+            nblk = 1 << lev
+            half = n // nblk // 2
+            L = np.zeros((n, n))
+            for b in range(nblk):
+                o = b * 2 * half
+                d0 = np.asarray(r0)[b * half:(b + 1) * half]
+                d1 = np.asarray(r1)[b * half:(b + 1) * half]
+                L[o:o + half, o:o + half] = s * np.diag(d0)
+                L[o:o + half, o + half:o + 2 * half] = s * np.diag(d1)
+                L[o + half:o + 2 * half, o:o + half] = s * np.diag(d0)
+                L[o + half:o + 2 * half, o + half:o + 2 * half] = \
+                    -s * np.diag(d1)
+            W = W @ L
+        return W
+
+    W = dense_w(u)
+    x = rng.standard_normal((n, 3))
+    np.testing.assert_allclose(np.asarray(rbt.apply_left(u, x)), W @ x,
+                               rtol=1e-13, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(rbt.apply_left_t(u, x)),
+                               W.T @ x, rtol=1e-13, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(rbt.apply_right(u, x.T)),
+                               x.T @ W, rtol=1e-13, atol=1e-13)
+
+
+def test_generate_validates():
+    with pytest.raises(ValueError):
+        rbt.generate(6)          # not a multiple of 4 at depth 2
+    with pytest.raises(ValueError):
+        rbt.generate(0)
+    assert rbt.padded_size(13) == 16
+    assert rbt.padded_size(16) == 16
+    assert rbt.padded_size(1) == 4
+
+
+# ------------------------------------------------------- gesv speculation
+
+def _wilkinson_growth(n):
+    """W = tril(-1) + I with last column 1: partial-pivot growth 2^(n-1),
+    the classic growth adversary."""
+    a = np.tril(-np.ones((n, n)), -1) + np.eye(n)
+    a[:, -1] = 1.0
+    return a
+
+
+@pytest.mark.parametrize("kind", ["random", "symmetric_indefinite",
+                                  "wilkinson", "zero_pivot"])
+def test_gesv_speculate_matches_oracle(rng, kind):
+    n, nb = 24, 8
+    if kind == "random":
+        a = rng.standard_normal((n, n))
+    elif kind == "symmetric_indefinite":
+        s = rng.standard_normal((n, n))
+        a = (s + s.T) / 2
+    elif kind == "wilkinson":
+        a = _wilkinson_growth(n)
+    else:
+        a = rng.standard_normal((n, n)) + n * np.eye(n)
+        a[0, 0] = 0.0
+    b = rng.standard_normal((n, 3))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    F, X, h = st.gesv(A, B, SPEC_INFO)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-9, atol=1e-9)
+
+
+def test_gesv_speculate_ragged(rng):
+    """n not a multiple of the butterfly granularity: identity padding."""
+    n, nb = 30, 7
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    F, X, h = st.gesv(st.Matrix.from_numpy(a, nb),
+                      st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_gesv_speculate_f32(rng):
+    n, nb = 24, 8
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    F, X, h = st.gesv(st.Matrix.from_numpy(a, nb),
+                      st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert bool(h.ok)
+    assert X.to_numpy().dtype == np.float32
+    np.testing.assert_allclose(
+        X.to_numpy(), np.linalg.solve(a.astype(np.float64), b),
+        rtol=5e-4, atol=5e-4)
+
+
+def test_gesv_speculate_jit(rng):
+    """The speculative fast path traces into one program; health rides
+    along as data (no eager escalation branch under jit)."""
+    n, nb = 24, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+
+    @jax.jit
+    def solve(ad, bd):
+        F, X, h = st.gesv(st.Matrix.from_numpy(ad, nb),
+                          st.Matrix.from_numpy(bd, nb), SPEC_INFO)
+        return X.to_dense(), h.ok
+
+    x, ok = solve(jnp.asarray(a), jnp.asarray(b))
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_gesv_speculate_off_is_default_path(rng):
+    """Speculate.Auto (the default) must leave gesv on the pivoted path —
+    the factor object is plain LUFactors, not RBTFactors."""
+    n, nb = 16, 8
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 2))
+    F, X = st.gesv(st.Matrix.from_numpy(a, nb), st.Matrix.from_numpy(b, nb))
+    assert isinstance(F, st.LUFactors)
+    F2, X2, h2 = st.gesv(st.Matrix.from_numpy(a, nb),
+                         st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert isinstance(F2, st.RBTFactors)
+
+
+# --------------------------------------------- certification / escalation
+
+def test_post_rbt_fault_escalates(rng):
+    """A persistent bitflip on the transformed matrix yields a finite but
+    wrong fast-path solve; the residual certificate must catch it and the
+    recovery ladder must escalate to pivoted LU — result still matches
+    the oracle and the factor is pivoted."""
+    n, nb = 24, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    with faults.inject(faults.FaultPlan(site="post_rbt", kind="bitflip")):
+        F, X, h = st.gesv(A, B, SPEC_INFO)
+    assert isinstance(F, st.LUFactors)      # escalated off the RBT path
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_post_rbt_fault_no_fallback_reports(rng):
+    """With the fallback solver disabled, the failed certificate must
+    surface in the health (Info) or as the typed exception (Raise)."""
+    n, nb = 24, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb)
+    o = dict(SPEC_INFO)
+    o[st.Option.UseFallbackSolver] = False
+    with faults.inject(faults.FaultPlan(site="post_rbt", kind="bitflip")):
+        F, X, h = st.gesv(A, B, o)
+    assert not bool(h.ok)
+    assert isinstance(F, st.RBTFactors)     # never left the fast path
+    o2 = dict(SPEC)
+    o2[st.Option.UseFallbackSolver] = False
+    with faults.inject(faults.FaultPlan(site="post_rbt", kind="bitflip")):
+        with pytest.raises(st.SlateSingularError):
+            st.gesv(A, B, o2)
+
+
+def test_rbt_transient_fault_certified_clean_retry(rng):
+    """A transient post_rbt strike corrupts only the first attempt: the
+    pivoted retry sees clean data and certifies."""
+    n, nb = 24, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    with faults.inject(faults.FaultPlan(site="post_rbt", kind="nan",
+                                        transient=True)):
+        F, X, h = st.gesv(st.Matrix.from_numpy(a, nb),
+                          st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_getrf_rbt_direct_roundtrip(rng):
+    """getrf_rbt + getrs as raw drivers (no recovery layer): the factor
+    reconstructs the transformed matrix and the solve matches."""
+    n, nb = 16, 8
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    A = st.Matrix.from_numpy(a, nb)
+    F, h = st.getrf_rbt(A, {st.Option.ErrorPolicy: "info"})
+    assert isinstance(F, st.RBTFactors)
+    X = st.getrs(F, st.Matrix.from_numpy(b, nb))
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-9, atol=1e-9)
+
+
+# ------------------------------------------------------ gels speculation
+
+def test_gels_speculate_matches_lstsq(rng):
+    """m=20, n=10 auto-selects QR (not tall-skinny enough); Speculate
+    forces the certified CholQR2 fast path, which must match."""
+    m, n, nb = 20, 10, 8
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 2))
+    X, h = st.gels(st.Matrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert bool(h.ok)
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy(), xref, rtol=1e-10, atol=1e-10)
+
+
+def test_gels_speculate_illconditioned_escalates(rng):
+    """cond(A)^2 beyond f64: the Gram certificate/factor fails and the
+    QR fallback must produce the accurate answer."""
+    m, n, nb = 20, 10, 8
+    u, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -12, n)
+    a = (u * s) @ v.T
+    b = rng.standard_normal((m, 2))
+    X, h = st.gels(st.Matrix.from_numpy(a, nb),
+                   st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    resid = np.linalg.norm(a.T @ (a @ X.to_numpy() - b))
+    resid_ref = np.linalg.norm(a.T @ (a @ xref - b))
+    assert resid < 1e-6 + 10 * resid_ref
+
+
+def test_gels_default_unchanged(rng):
+    """Without Speculate the auto heuristic still routes tall-skinny to
+    CholQR and near-square to QR, matching lstsq either way."""
+    for m, n in [(40, 8), (20, 16)]:
+        a = rng.standard_normal((m, n))
+        b = rng.standard_normal((m, 2))
+        X = st.gels(st.Matrix.from_numpy(a, 8), st.Matrix.from_numpy(b, 8))
+        np.testing.assert_allclose(
+            X.to_numpy(), np.linalg.lstsq(a, b, rcond=None)[0],
+            rtol=1e-9, atol=1e-9)
+
+
+# ------------------------------------------------------ hesv speculation
+
+def test_hesv_speculate_hpd_first_try(rng):
+    n, nb = 24, 8
+    s = rng.standard_normal((n, n))
+    hpd = s @ s.T + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(hpd, nb, uplo=st.Uplo.Lower)
+    F, X, h = st.hesv(A, st.Matrix.from_numpy(b, nb), SPEC_INFO)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(hpd, b),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_hesv_speculate_indefinite_falls_back(rng):
+    """An indefinite Hermitian input fails the Cholesky speculation and
+    must land on the Aasen rung — even with UseFallbackSolver off (the
+    Aasen fallback is hesv's baseline contract, not an extra)."""
+    n, nb = 24, 8
+    s = rng.standard_normal((n, n))
+    indef = (s + s.T) / 2
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix.from_numpy(indef, nb, uplo=st.Uplo.Lower)
+    o = dict(SPEC_INFO)
+    o[st.Option.UseFallbackSolver] = False
+    F, X, h = st.hesv(A, st.Matrix.from_numpy(b, nb), o)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(indef, b),
+                               rtol=1e-9, atol=1e-9)
+
+
+# -------------------------------------------------------------- mesh path
+
+@pytest.mark.slow
+def test_dist_rbt_two_sided_matches_dense(rng):
+    from slate_tpu.parallel.dist_lu import dist_rbt_two_sided
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = rng.standard_normal((n, n))
+    A = st.Matrix.from_numpy(a, nb, grid=g)
+    u = rbt.generate(n, seed=11, dtype=np.float64)
+    v = rbt.generate(n, seed=12, dtype=np.float64)
+    data = dist_rbt_two_sided(A.storage.data, u, v, g, n)
+    got = st.Matrix(st.TileStorage(data, n, n, nb, nb, g)).to_numpy()
+    np.testing.assert_allclose(got, np.asarray(rbt.transform(a, u, v)),
+                               rtol=1e-13, atol=1e-13)
+
+
+@pytest.mark.slow
+def test_gesv_speculate_mesh(rng):
+    n, nb = 16, 4
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    A = st.Matrix.from_numpy(a, nb, grid=g)
+    B = st.Matrix.from_numpy(b, nb, grid=g)
+    o = dict(SPEC_INFO)
+    o[st.Option.Target] = "mesh"
+    F, X, h = st.gesv(A, B, o)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               rtol=1e-10, atol=1e-10)

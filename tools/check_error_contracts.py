@@ -15,6 +15,20 @@ anywhere):
    / ``HealthInfo``) somewhere in its body — an import alone is not a
    contract.
 
+Plus the speculation-seam contract (Option.Speculate, docs/ROBUSTNESS.md):
+
+4. ``internal/rbt.py`` stays pure mechanism — it must not import the
+   options or robust layers (the policy seam lives in drivers/lu.py and
+   robust/recovery.py);
+5. every speculative boundary function (recovery.py's
+   gesv/gels/hesv_with_recovery, mixed.py's gesv_mixed) calls
+   ``resolve_speculate`` EXACTLY once — the knob is resolved at the
+   driver boundary like ErrorPolicy, never re-read downstream — and the
+   recovery boundaries route through ``bounded_retry`` and finalize the
+   (result, HealthInfo) pair exactly once;
+6. no driver module reads the raw ``Option.Speculate`` knob — drivers
+   consume the resolved boolean, the enum never leaks past the boundary.
+
 Runnable as a main (exit 1 + report on violation) and as pytest via
 tests/test_error_contracts.py.
 """
@@ -91,8 +105,98 @@ def _references_health(tree: ast.Module) -> bool:
     return False
 
 
-def check() -> list[str]:
+# speculation boundaries: file -> functions that must resolve the knob
+# exactly once (and, for the recovery ones, retry + finalize exactly once)
+SPECULATIVE_BOUNDARIES = {
+    REPO / "slate_tpu" / "robust" / "recovery.py":
+        ("gesv_with_recovery", "gels_with_recovery", "hesv_with_recovery"),
+    DRIVERS / "mixed.py": ("gesv_mixed",),
+}
+RECOVERY_BOUNDARIES = {"gesv_with_recovery", "gels_with_recovery",
+                       "hesv_with_recovery"}
+RBT_MODULE = REPO / "slate_tpu" / "internal" / "rbt.py"
+FINALIZE_NAMES = {"finalize", "_finalize_solve"}
+
+
+def _count_calls(fn: ast.FunctionDef, names: set[str]) -> int:
+    c = 0
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in names:
+                c += 1
+            elif isinstance(f, ast.Attribute) and f.attr in names:
+                c += 1
+    return c
+
+
+def _check_speculation() -> list[str]:
     problems = []
+    # 4. rbt.py: pure mechanism, policy-free
+    if not RBT_MODULE.exists():
+        problems.append("internal/rbt.py: missing (the RBT mechanism "
+                        "module the speculative gesv path builds on)")
+    else:
+        tree = ast.parse(RBT_MODULE.read_text(), filename=str(RBT_MODULE))
+        for node in ast.walk(tree):
+            mods = []
+            if isinstance(node, ast.ImportFrom) and node.module:
+                mods = node.module.split(".")
+            elif isinstance(node, ast.Import):
+                mods = [s for a in node.names for s in a.name.split(".")]
+            if "options" in mods or "robust" in mods:
+                problems.append(
+                    f"internal/rbt.py:{node.lineno}: imports the "
+                    f"options/robust layer — the butterfly mechanism must "
+                    f"stay policy-free (the seam is drivers/lu.py + "
+                    f"robust/recovery.py)")
+    # 5. boundary functions resolve the knob exactly once
+    for path, fns in SPECULATIVE_BOUNDARIES.items():
+        rel = path.relative_to(REPO)
+        if not path.exists():
+            problems.append(f"{rel}: missing speculative boundary module")
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        defs = {n.name: n for n in tree.body
+                if isinstance(n, ast.FunctionDef)}
+        for fname in fns:
+            fn = defs.get(fname)
+            if fn is None:
+                problems.append(f"{rel}: speculative boundary "
+                                f"`{fname}` not found")
+                continue
+            n_res = _count_calls(fn, {"resolve_speculate"})
+            if n_res != 1:
+                problems.append(
+                    f"{rel}:{fn.lineno}: `{fname}` calls "
+                    f"resolve_speculate {n_res}x — the knob must be "
+                    f"resolved EXACTLY once at the boundary")
+            if fname in RECOVERY_BOUNDARIES:
+                if _count_calls(fn, {"bounded_retry"}) < 1:
+                    problems.append(
+                        f"{rel}:{fn.lineno}: `{fname}` never routes "
+                        f"through bounded_retry — speculation has no "
+                        f"escalation path")
+                n_fin = _count_calls(fn, FINALIZE_NAMES)
+                if n_fin != 1:
+                    problems.append(
+                        f"{rel}:{fn.lineno}: `{fname}` finalizes "
+                        f"{n_fin}x — the (result, HealthInfo) pair must "
+                        f"resolve ErrorPolicy exactly once")
+    # 6. the raw knob never leaks into a driver module
+    for path in sorted(DRIVERS.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) and node.attr == "Speculate":
+                problems.append(
+                    f"drivers/{path.name}:{node.lineno}: reads "
+                    f"Option.Speculate directly — drivers consume "
+                    f"resolve_speculate's boolean, never the raw knob")
+    return problems
+
+
+def check() -> list[str]:
+    problems = _check_speculation()
     for name in CHECKED_MODULES:
         path = DRIVERS / name
         if not path.exists():
