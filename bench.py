@@ -233,6 +233,60 @@ def bench_gesv_rbt(n, nb, nrhs, iters):
           {"nb": nb, "nrhs": nrhs, "method": "rbt+nopiv"})
 
 
+def bench_gesv_abft(n, nb, nrhs, iters):
+    """gesv under Option.Abft (Huang-Abraham checksum verification of the
+    panel, the U12 solve, and the trailing update — robust/abft.py) timed
+    against the identical plain run: the emitted value is the protected
+    GFLOP/s, ``abft_overhead_pct`` the wall-clock cost of the O(n^2)
+    checksum shadow over the O(n^3) it guards."""
+    rng = np.random.default_rng(8)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
+
+    def body_for(opts):
+        def body(carry, a, b):
+            A = _mat(a * (1.0 + carry), nb, nb)
+            out = st.gesv(A, _mat(b, nb, nb), opts)
+            return out[1].to_dense()[0, 0] * 1e-24
+        return body
+
+    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
+    plain = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
+                        flops)
+    prot = _time_chain(
+        body_for({st.Option.Abft: "on", st.Option.ErrorPolicy: "info"}),
+        jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gesv_abft_n{n}_gflops_per_chip", prot,
+          {"nb": nb, "nrhs": nrhs, "plain_gflops": round(float(plain), 1),
+           "abft_overhead_pct": round((plain / prot - 1.0) * 100.0, 1)})
+
+
+def bench_posv_abft(n, nb, nrhs, iters):
+    """posv under Option.Abft vs plain (see bench_gesv_abft)."""
+    rng = np.random.default_rng(9)
+    a0 = rng.standard_normal((n, n)).astype(np.float32)
+    a = jnp.asarray(a0 + a0.T) * 0.001 + jnp.eye(n, dtype=jnp.float32) * 4.0
+    b = jnp.asarray(rng.standard_normal((n, nrhs)).astype(np.float32))
+
+    def body_for(opts):
+        def body(carry, a, b):
+            H = st.HermitianMatrix._from_view(
+                _mat(a * (1.0 + carry), nb, nb), st.Uplo.Lower)
+            out = st.posv(H, _mat(b, nb, nb), opts)
+            return out[1].to_dense()[0, 0] * 1e-24
+        return body
+
+    flops = n**3 / 3.0 + 2.0 * n * n * nrhs
+    plain = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
+                        flops)
+    prot = _time_chain(
+        body_for({st.Option.Abft: "on", st.Option.ErrorPolicy: "info"}),
+        jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"posv_abft_n{n}_gflops_per_chip", prot,
+          {"nb": nb, "nrhs": nrhs, "plain_gflops": round(float(plain), 1),
+           "abft_overhead_pct": round((plain / prot - 1.0) * 100.0, 1)})
+
+
 def bench_heev(n, nb, iters):
     """Two-stage eigensolver, values only (BASELINE config #5 family).
 
@@ -276,6 +330,8 @@ QUICK_STEPS = [
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
     (bench_gesv, dict(n=768, nb=128, nrhs=64, iters=2)),
     (bench_gesv_rbt, dict(n=768, nb=128, nrhs=64, iters=2)),
+    (bench_gesv_abft, dict(n=768, nb=128, nrhs=64, iters=2)),
+    (bench_posv_abft, dict(n=768, nb=128, nrhs=64, iters=2)),
     (bench_geqrf, dict(m=4096, n=256, nb=128, iters=2)),
     (bench_gels, dict(m=4096, n=256, nb=128, nrhs=16, iters=2)),
     (bench_heev, dict(n=512, nb=128, iters=2)),
@@ -289,6 +345,8 @@ FULL_STEPS = [
     (bench_posv, dict(n=16384, nb=512, nrhs=256, iters=5)),
     (bench_gesv, dict(n=16384, nb=512, nrhs=256, iters=4)),
     (bench_gesv_rbt, dict(n=16384, nb=512, nrhs=256, iters=4)),
+    (bench_gesv_abft, dict(n=16384, nb=512, nrhs=256, iters=3)),
+    (bench_posv_abft, dict(n=16384, nb=512, nrhs=256, iters=3)),
     (bench_geqrf, dict(m=131072, n=1024, nb=256, iters=4)),
     (bench_gels, dict(m=131072, n=1024, nb=256, nrhs=64, iters=4)),
     (bench_heev, dict(n=4096, nb=256, iters=3)),

@@ -22,9 +22,10 @@ from ..core.grid import Grid
 from ..core.matrix import BaseMatrix, Matrix
 from ..core.storage import TileStorage
 from ..exceptions import slate_error
-from ..options import (MethodGemm, Option, Options, Target,
+from ..options import (MethodGemm, Option, Options, Target, resolve_abft,
                        resolve_target, select_gemm_method)
 from ..parallel import summa
+from ..robust import abft as _abft
 from ..types import Diag, Op, Side, Uplo
 from ..util.trace import annotate
 
@@ -66,6 +67,7 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
     slate_error(C.m == A.m and C.n == B.n, "gemm: C dims differ")
     target = resolve_target(opts, C)
     method = select_gemm_method(opts, C.nt)
+    abft = resolve_abft(opts)  # the one Option.Abft read (driver boundary)
 
     if target is Target.mesh and C.grid.mesh is not None:
         # All operands are normalised onto C's grid (redistributing if they
@@ -81,6 +83,14 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
             data = dist_gemmA_data(
                 An.storage.data, Bn.storage.data, Cn.storage.data,
                 alpha, beta, An.storage.Nt, Cn.grid)
+        elif abft:
+            # gemm has no health channel, so ABFT here is SILENT repair:
+            # a single struck accumulator tile is fixed in place, the
+            # counters are dropped (an uncorrectable multi-strike leaves
+            # the data for the caller's certification to catch)
+            data, _, _, _ = summa.summa_gemm_data(
+                An.storage.data, Bn.storage.data, Cn.storage.data,
+                alpha, beta, An.storage.Nt, Cn.grid, abft=True)
         else:
             data = summa.summa_gemm_data(
                 An.storage.data, Bn.storage.data, Cn.storage.data,
@@ -91,7 +101,12 @@ def gemm(alpha, A: BaseMatrix, B: BaseMatrix, beta=0.0,
     # skip their passes entirely — XLA cannot fold 0*C itself (0*NaN
     # semantics), and the beta=0 path otherwise materialises and reads a
     # zeros C for nothing (measured ~35% of the n=8192 gemm wall-clock)
-    Cd = A.to_dense() @ B.to_dense()
+    Ad, Bd = A.to_dense(), B.to_dense()
+    Cd = Ad @ Bd
+    if abft:
+        # additive checksums of the raw product (silent repair, as above)
+        Cd, _ = _abft.sum_check(Cd, Ad @ jnp.sum(Bd, axis=1),
+                                jnp.sum(Ad, axis=0) @ Bd, n_ctx=A.n)
     if not (isinstance(alpha, (int, float)) and alpha == 1.0):
         Cd = jnp.asarray(alpha, Cd.dtype) * Cd
     if not (isinstance(beta, (int, float)) and beta == 0.0):
@@ -137,6 +152,7 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
         slate_error(B.n == A.m, "trsm: dims")
     target = resolve_target(opts, B)
     unit = A.diag is Diag.Unit
+    abft = resolve_abft(opts)  # the one Option.Abft read (driver boundary)
 
     if target is Target.mesh and B.grid.mesh is not None:
         meth = select_trsm_method(opts, B.nt)
@@ -172,7 +188,8 @@ def trsm(side, alpha, A, B, opts: Options | None = None) -> Matrix:
         # 4.1 TFLOP/s at [16384, 256]); ragged n identity-augmented inside
         from ..internal.trsm import trsm_left_blocked, trsm_right_blocked
         kw = dict(lower=lower, trans=(A.op is not Op.NoTrans),
-                  conj=(A.op is Op.ConjTrans), unit=unit, nb=nb)
+                  conj=(A.op is Op.ConjTrans), unit=unit, nb=nb,
+                  check=abft)  # checksum-verify + silent single repair
         xd = (trsm_left_blocked(ad, bd, **kw) if sd is Side.Left
               else trsm_right_blocked(ad, bd, **kw))
         return _dense_to_like(B, xd)

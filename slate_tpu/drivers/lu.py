@@ -26,8 +26,10 @@ from ..core.storage import TileStorage
 from ..exceptions import SlateSingularError, slate_error
 from ..ops.elementwise import entry_mask
 from ..options import (ErrorPolicy, MethodLU, Option, Options, Target,
-                       get_option, resolve_target, select_lu_method)
+                       get_option, resolve_abft, resolve_target,
+                       select_lu_method)
 from ..parallel.dist_lu import dist_getrf
+from ..robust import abft as _abft
 from ..robust import faults
 from ..robust import health as _health
 from ..types import Diag, Op, Uplo
@@ -64,7 +66,7 @@ def _apply_row_perm(mat, perm, bound: int):
 
 
 def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
-                         mpt: int = 4, depth: int = 2):
+                         mpt: int = 4, depth: int = 2, abft: bool = False):
     """Blocked right-looking LU, statically-shaped panels (unrolled).
 
     Panel factor delegates to XLA's native pivoted LU (the analog of the
@@ -76,16 +78,26 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
     (Option.MaxPanelThreads) splits the tournament panel into ~mpt
     independent row blocks (the analog of panel threads: more threads =
     more, smaller blocks) and ``depth`` (Option.Depth) is the
-    reduction-tree fan-in."""
+    reduction-tree fan-in.
+
+    With ``abft`` (the resolved Option.Abft boolean) every step carries
+    Huang-Abraham checksums (robust/abft.py): the packed panel is
+    verified against its pre-factor input, the U12 solve against the
+    pre-solve row's checksums, and the trailing update against the
+    expected checksum deltas — each an O(n^2)-per-step check that
+    locates and repairs a single corrupted element in place.  Returns
+    ``(factor, perm, AbftCounts)``."""
     from ..internal.getrf import (panel_lu, panel_lu_nopiv,
                                   panel_lu_threshold, panel_lu_tournament)
     from ..internal.trsm import tri_inv_lower
     m, n = a.shape
     kmax = min(m, n)
     perm_g = jnp.arange(m)
+    counts = _abft.zero_counts()
     for k0 in range(0, kmax, nb):
         k1 = min(k0 + nb, kmax)
         w = k1 - k0
+        kt = k0 // nb
         pan = a[k0:, k0:k1]
         if method == "nopiv":
             lu, perm = panel_lu_nopiv(pan)
@@ -98,6 +110,11 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
         else:
             lu, perm = panel_lu(pan)
         lu = faults.maybe_corrupt("post_panel", lu)
+        if abft:
+            lu, det, cor, pi, _ = _abft.lu_panel_check(pan, lu, perm,
+                                                       n_ctx=m)
+            counts = _abft.add_counts(counts, _abft.count_event(
+                det, cor, kt + pi // nb, kt))
         a = a.at[k0:, k0:k1].set(lu)
         if method != "nopiv":
             a = a.at[k0:, :k0].set(_apply_row_perm(a[k0:, :k0], perm, 2 * w))
@@ -105,12 +122,31 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
             perm_g = perm_g.at[k0:].set(perm_g[k0:][perm])
         if k1 < n:
             l11 = lu[:w, :w]
-            u12 = tri_inv_lower(l11, unit_diag=True) @ a[k0:k1, k1:]
+            r12 = a[k0:k1, k1:]
+            u12 = tri_inv_lower(l11, unit_diag=True) @ r12
+            if abft:
+                u12, det, cor, _, pj = _abft.left_product_check(
+                    l11, u12, jnp.sum(r12, axis=1), jnp.sum(r12, axis=0),
+                    unit=True, n_ctx=m)
+                counts = _abft.add_counts(counts, _abft.count_event(
+                    det, cor, kt, (k1 + pj) // nb))
             a = a.at[k0:k1, k1:].set(u12)
             if k1 < m:
                 l21 = lu[w:, :w]
-                a = a.at[k1:, k1:].add(-(l21 @ u12))
-    return a, perm_g
+                if abft:
+                    tb = a[k1:, k1:]
+                    exp_row = (jnp.sum(tb, axis=1)
+                               - l21 @ jnp.sum(u12, axis=1))
+                    exp_col = (jnp.sum(tb, axis=0)
+                               - jnp.sum(l21, axis=0) @ u12)
+                    tb, ev = _abft.sum_check(tb - l21 @ u12, exp_row,
+                                             exp_col, n_ctx=m, nb=nb,
+                                             row0=k1, col0=k1)
+                    counts = _abft.add_counts(counts, ev)
+                    a = a.at[k1:, k1:].set(tb)
+                else:
+                    a = a.at[k1:, k1:].add(-(l21 @ u12))
+    return a, perm_g, counts
 
 
 @annotate("slate.getrf")
@@ -244,12 +280,22 @@ def _lu_health(factor_arr, minpiv, minidx, amax):
     )
 
 
+def _abft_fold(h, counts: "_abft.AbftCounts"):
+    """Fold checksum-verification counters into a HealthInfo: a detected
+    but uncorrected strike flips ``h.ok`` (health.py), which is what the
+    recovery ladder escalates on."""
+    return h._replace(abft_detected=counts.detected,
+                      abft_corrected=counts.corrected,
+                      abft_site=counts.site)
+
+
 def _getrf(A: Matrix, opts: Options | None, method: str):
     target = resolve_target(opts, A)
     nb = A.nb
     tau = float(get_option(opts, Option.PivotThreshold))
     mpt = int(get_option(opts, Option.MaxPanelThreads))
     depth = int(get_option(opts, Option.Depth))
+    abft = resolve_abft(opts)
 
     if target is Target.mesh and A.grid.mesh is not None:
         from ..parallel.dist_chol import SUPERBLOCKS, superblock
@@ -259,11 +305,11 @@ def _getrf(A: Matrix, opts: Options | None, method: str):
         data_in = faults.maybe_corrupt("input", st.data)
         amax = jnp.max(jnp.abs(data_in))
         la = max(1, int(get_option(opts, Option.Lookahead)))
-        data, perm, minpiv, minidx = dist_getrf(
+        data, perm, minpiv, minidx, adet, acor, asite = dist_getrf(
             data_in, st.Nt, A.grid, st.n, method,
             ib=get_option(opts, Option.InnerBlocking),
             sb=superblock(st.Nt, SUPERBLOCKS * la),
-            tau=tau, mpt=mpt, depth=depth)
+            tau=tau, mpt=mpt, depth=depth, abft=abft)
         out = TileStorage(data, st.m, st.n, nb, nb, st.grid)
         # restore the pad-region-zero invariant (final ragged panel is
         # identity-augmented inside the factorization)
@@ -271,19 +317,20 @@ def _getrf(A: Matrix, opts: Options | None, method: str):
             out.dtype)
         out = out.with_canonical(clean)
         F = LUFactors(Matrix(out), perm[: st.m])
-        h = _lu_health(clean, minpiv, minidx, amax)
+        h = _abft_fold(_lu_health(clean, minpiv, minidx, amax),
+                       _abft.AbftCounts(adet, acor, asite))
         return _health.finalize(f"getrf[{method}]", F, h, opts,
                                 _singular(f"getrf[{method}]"))
 
     ad = faults.maybe_corrupt("input", A.to_dense())
     amax = jnp.max(jnp.abs(ad))
-    lu, perm = _getrf_dense_blocked(ad, nb, method, tau=tau, mpt=mpt,
-                                    depth=depth)
+    lu, perm, counts = _getrf_dense_blocked(ad, nb, method, tau=tau,
+                                            mpt=mpt, depth=depth, abft=abft)
     st = TileStorage.from_dense(lu, nb, nb, A.grid)
     F = LUFactors(Matrix(st), perm)
     udiag = jnp.abs(jnp.diagonal(lu))
     minidx = jnp.argmin(udiag)
-    h = _lu_health(lu, udiag[minidx], minidx, amax)
+    h = _abft_fold(_lu_health(lu, udiag[minidx], minidx, amax), counts)
     return _health.finalize(f"getrf[{method}]", F, h, opts,
                             _singular(f"getrf[{method}]"))
 

@@ -39,3 +39,20 @@ def blocked_gemm(a_tiles, b_tiles):
     """
     return jnp.einsum("ikab,kjbc->ijac", a_tiles, b_tiles,
                       preferred_element_type=a_tiles.dtype)
+
+
+def tile_product_row_sums(a_tiles, b_tiles):
+    """Row checksums of the blocked product ``sum_k A[i,k] B[k,j]``
+    computed WITHOUT forming it: ``A (B e)`` at O(tiles * nb^2) — the
+    Huang-Abraham checksum shadow of :func:`blocked_gemm` (a rank-1 tile
+    pair ``a_col[:, None] / b_row[None]`` gives the shadow of
+    :func:`tile_outer_product`).  robust/abft.py verifies results
+    against these and repairs single corrupted elements."""
+    be = jnp.sum(b_tiles, axis=-1)
+    return jnp.einsum("ikab,kjb->ija", a_tiles, be)
+
+
+def tile_product_col_sums(a_tiles, b_tiles):
+    """Column checksums of the blocked product: ``(e^T A) B``."""
+    ea = jnp.sum(a_tiles, axis=-2)
+    return jnp.einsum("ikb,kjbc->ijc", ea, b_tiles)

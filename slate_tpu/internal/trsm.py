@@ -116,8 +116,28 @@ def _pad_tri(ad, nb: int):
             .at[r, r].set(1)), n
 
 
+def _checksum_repair(a_op, x, bd, *, eff_lower: bool, unit: bool):
+    """Verify the finished solve ``a_op @ x == bd`` through bd's
+    Huang-Abraham checksums and repair ONE corrupted element of x in
+    place (robust/abft.py, lazy import — robust pulls in the driver
+    layer at package init).  The upper-triangular case is index-reversed
+    into the canonical lower-left product: ``P A P`` is lower for the
+    reversal permutation P, column sums are P-invariant and row sums
+    P-equivariant."""
+    from ..robust.abft import left_product_check
+    r_row = jnp.sum(bd, axis=1)
+    r_col = jnp.sum(bd, axis=0)
+    if eff_lower:
+        x2, _, _, _, _ = left_product_check(a_op, x, r_row, r_col,
+                                            unit=unit)
+        return x2
+    x2, _, _, _, _ = left_product_check(a_op[::-1, ::-1], x[::-1],
+                                        r_row[::-1], r_col, unit=unit)
+    return x2[::-1]
+
+
 def trsm_left_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
-                      unit: bool, nb: int):
+                      unit: bool, nb: int, check: bool = False):
     """Solve op(A) X = B, A triangular [n, n], by block substitution with
     ALL diagonal blocks inverted in one batched log-depth pass
     (tri_inv_lower) — each step is then two MXU gemms.  A ragged n (not a
@@ -154,11 +174,14 @@ def trsm_left_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
             x_done = jnp.concatenate(xs[k + 1:], axis=0)
             acc = acc - a_op[k0:k1, k1:] @ x_done
         xs[k] = dinv[k] @ acc
-    return jnp.concatenate(xs, axis=0)[:n0]
+    x = jnp.concatenate(xs, axis=0)
+    if check:
+        x = _checksum_repair(a_op, x, bd, eff_lower=eff_lower, unit=unit)
+    return x[:n0]
 
 
 def trsm_right_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
-                       unit: bool, nb: int):
+                       unit: bool, nb: int, check: bool = False):
     """Solve X op(A) = B by block substitution over block columns (right
     side twin of trsm_left_blocked; ragged n identity-augmented)."""
     ad, n0 = _pad_tri(ad, nb)
@@ -189,4 +212,9 @@ def trsm_right_blocked(ad, bd, *, lower: bool, trans: bool, conj: bool,
             x_done = jnp.concatenate(xs[:k], axis=1)
             acc = acc - x_done @ a_op[:k0, k0:k1]
         xs[k] = acc @ dinv[k]
-    return jnp.concatenate(xs, axis=1)[:, :n0]
+    x = jnp.concatenate(xs, axis=1)
+    if check:
+        # X op(A) = B  <=>  op(A)^T X^T = B^T: the left check transposed
+        x = _checksum_repair(a_op.T, x.T, bd.T,
+                             eff_lower=not eff_lower, unit=unit).T
+    return x[:, :n0]

@@ -78,6 +78,27 @@ class Speculate(enum.Enum):
     On = "on"
 
 
+class Abft(enum.Enum):
+    """Algorithm-based fault tolerance mode (robust/abft.py).
+
+    With ``Abft.On`` the blocked GEMM/LU/Cholesky paths carry Huang-
+    Abraham row/column checksums through every panel + trailing-update
+    step: a checksum mismatch is DETECTED, the corrupted tile is LOCATED
+    from the row/column residual cross-pattern, and single-element strikes
+    are CORRECTED in place by checksum reconstruction — an O(n^2) repair
+    rung below the O(n^3) method-escalation ladder.  Counters fold into
+    HealthInfo (abft_detected / abft_corrected / abft_site).
+
+    Auto    currently Off (the heuristic seam for future auto-enabling)
+    Off     no checksum maintenance (zero overhead)
+    On      checksum-verified factorizations + localized repair
+    """
+
+    Auto = "auto"
+    Off = "off"
+    On = "on"
+
+
 class Option(enum.Enum):
     """Option keys (ref: enums.hh:69-101)."""
 
@@ -90,6 +111,7 @@ class Option(enum.Enum):
     Target = "target"
     ErrorPolicy = "error_policy"
     Speculate = "speculate"
+    Abft = "abft"
     UseFallbackSolver = "use_fallback_solver"
     PivotThreshold = "pivot_threshold"
     MethodGemm = "method_gemm"
@@ -208,6 +230,7 @@ _DEFAULTS = {
     Option.Target: Target.auto,
     Option.ErrorPolicy: ErrorPolicy.Raise,
     Option.Speculate: Speculate.Auto,
+    Option.Abft: Abft.Auto,
     Option.UseFallbackSolver: True,
     Option.PivotThreshold: 1.0,
     Option.MethodGemm: MethodGemm.Auto,
@@ -233,7 +256,7 @@ _UNSET = object()
 # uniformly ({Option.Target: "mesh"}, {Option.ErrorPolicy: "info"}) and
 # coerced here so every consumer sees the enum.
 _ENUM_VALUED = {Option.Target: Target, Option.ErrorPolicy: ErrorPolicy,
-                Option.Speculate: Speculate}
+                Option.Speculate: Speculate, Option.Abft: Abft}
 
 
 def get_option(opts: Options | None, key: Option,
@@ -273,6 +296,15 @@ def resolve_speculate(opts: Options | None) -> bool:
     default solver behavior is unchanged.  Every consumer below the
     boundary receives the decision, never the knob."""
     return get_option(opts, Option.Speculate) is Speculate.On
+
+
+def resolve_abft(opts: Options | None) -> bool:
+    """Resolve Option.Abft ONCE at a driver boundary (same discipline as
+    ErrorPolicy / Speculate): True only for an explicit ``Abft.On`` —
+    ``Auto`` currently maps to Off so default drivers pay zero checksum
+    overhead.  Every consumer below the boundary receives the resolved
+    boolean, never the knob."""
+    return get_option(opts, Option.Abft) is Abft.On
 
 
 def select_gemm_method(opts: Options | None, nt: int) -> MethodGemm:

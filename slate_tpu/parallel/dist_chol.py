@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.herk import herk_panel_update
+from ..robust import abft as _abft
 from ..robust import faults
 from ..util.compat_jax import shard_map_unchecked
 from ..util.trace import span
@@ -54,7 +55,7 @@ def superblock(Nt: int, target: int = SUPERBLOCKS) -> int:
 
 
 def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
-                 sb: int):
+                 sb: int, abft: bool = False):
     """Per-shard body; a_loc [mtl, ntl, nb, nb] block-cyclic local tiles."""
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
@@ -69,9 +70,15 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
     rdt = jnp.zeros((), dt).real.dtype
     minpiv = jnp.asarray(jnp.inf, rdt)
     minidx = jnp.zeros((), jnp.int32)
+    # ABFT counters (same discipline as dist_lu): ``rep`` for checks of
+    # replicated data (diag tile, broadcast panel) — never mesh-summed;
+    # ``loc`` for each rank's own trailing tiles — psum'd at the end.
+    neg1 = jnp.asarray(-1, jnp.int32)
+    rep = (zi, zi, neg1)
+    loc = (zi, zi, neg1)
 
     def step(k, carry):
-        a_loc, minpiv, minidx = carry
+        a_loc, minpiv, minidx, rep, loc = carry
         rk, ck = k % p, k % q
         kkr, kkc = k // p, k // q
         # valid extent of diagonal tile k (ragged last tile); pad diagonal
@@ -100,6 +107,15 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
             dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
             lkk_aug = potrf_tile(dtile + pad_eye)
             lkk_aug = faults.maybe_corrupt("post_panel", lkk_aug)
+            if abft:
+                # verify/repair the replicated diag factor BEFORE the
+                # health trace reads its diagonal (a corrected strike
+                # must not leave a phantom zero pivot)
+                lkk_aug, det, cor = _abft.chol_tile_check(
+                    dtile + pad_eye, lkk_aug, n_ctx=n)
+                ev = _abft.count_event(det, cor, k, k)
+                rep = (rep[0] + ev.detected, rep[1] + ev.corrected,
+                       jnp.where(rep[2] >= 0, rep[2], ev.site))
             lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
 
             # health trace: smallest L diagonal (replicated — every rank
@@ -129,13 +145,48 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
         # -- broadcast the panel column to every rank (ref listBcastMT
         #    potrf.cc:232-242): scatter to global buffer, psum the mesh --
         with span("slate.potrf/bcast"):
-            buf = jnp.zeros((p * mtl, nb, nb), dt)
             contrib = jnp.where((gi_all > k)[:, None, None], sol,
                                 jnp.zeros_like(sol))
-            buf = buf.at[gi_all].set(contrib)
-            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
-            gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)  # [p*mtl, nb, nb]
-        return (a_loc, minpiv, minidx), gpan
+            if abft:
+                # checksums of R (the pre-solve panel column) ride the
+                # SAME psum as the solved tiles: payload [.., nb+1, nb+1],
+                # no extra collective round.  The broadcast result is
+                # replicated, so every rank runs the identical per-tile
+                # verify of X L^H = R (as L X^H = R^H) -> rep counters.
+                augl = jnp.zeros((mtl, nb + 1, nb + 1), dt)
+                augl = augl.at[:, :nb, :nb].set(contrib)
+                rmask = (gi_all > k)[:, None]
+                augl = augl.at[:, :nb, nb].set(
+                    jnp.where(rmask, jnp.sum(pan, axis=2), 0))
+                augl = augl.at[:, nb, :nb].set(
+                    jnp.where(rmask, jnp.sum(pan, axis=1), 0))
+                buf = jnp.zeros((p * mtl, nb + 1, nb + 1), dt)
+                buf = buf.at[gi_all].set(augl)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+                aug = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+                gpan = faults.maybe_corrupt("post_collective",
+                                            aug[:, :nb, :nb])
+                r_row = jnp.conj(aug[:, nb, :nb])  # (R^H) e = conj(e^T R)
+                r_col = jnp.conj(aug[:, :nb, nb])  # e^T R^H = conj(R e)
+                xh, det_t, cor_t, _, _ = jax.vmap(
+                    lambda xx, rr, cc: _abft.left_product_check(
+                        lkk_aug, jnp.conj(xx).T, rr, cc,
+                        unit=False, n_ctx=n))(gpan, r_row, r_col)
+                gpan = jnp.conj(xh).transpose(0, 2, 1)
+                live = jnp.arange(p * mtl) > k
+                det_n = jnp.sum(live & det_t, dtype=jnp.int32)
+                cor_n = jnp.sum(live & cor_t, dtype=jnp.int32)
+                ti_g = jnp.argmax(live & det_t).astype(jnp.int32)
+                s = jnp.where(det_n > 0, _abft.site_code(ti_g, k), neg1)
+                rep = (rep[0] + det_n, rep[1] + cor_n,
+                       jnp.where(rep[2] >= 0, rep[2], s))
+            else:
+                buf = jnp.zeros((p * mtl, nb, nb), dt)
+                buf = buf.at[gi_all].set(contrib)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+                gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+                gpan = faults.maybe_corrupt("post_collective", gpan)
+        return (a_loc, minpiv, minidx, rep, loc), gpan
 
     for k0 in range(0, Nt, sb):
         k1 = min(k0 + sb, Nt)
@@ -145,9 +196,10 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
         T = ntl - (k0 // q)
 
         def super_step(k, carry, S=S, T=T):
-            (a_loc, minpiv, minidx), gpan = step(k, carry)
+            (a_loc, minpiv, minidx, rep, loc), gpan = step(k, carry)
 
-            def trailing(a_loc):
+            def trailing(args):
+                a_loc, loc = args
                 sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
                 sc = jnp.clip(-(-(k0 - c) // q), 0, ntl - T).astype(jnp.int32)
                 gi = r + p * (sr + jnp.arange(S))
@@ -160,33 +212,63 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                                         (S, T, nb, nb))
                 mask = ((gi > k)[:, None, None, None] &
                         (gj > k)[None, :, None, None])
-                new = jnp.where(mask, cur - upd, cur)
+                new = cur - upd
+                if abft:
+                    # per-tile checksum maintenance of the rank-local
+                    # herk (dead tiles have zero gpan entries, so their
+                    # expectation collapses to cur's own sums)
+                    pch = jnp.conj(pcol).transpose(0, 2, 1)
+                    exp_r = (jnp.sum(cur, axis=3)
+                             - _abft.tile_product_row_sums(
+                                 prow[:, None], pch[None]))
+                    exp_c = (jnp.sum(cur, axis=2)
+                             - _abft.tile_product_col_sums(
+                                 prow[:, None], pch[None]))
+                    new, ev, ti_l, tj_l = _abft.tile_sum_check(
+                        new, exp_r, exp_c, n_ctx=n)
+                    s = jnp.where(ev.detected > 0,
+                                  _abft.site_code(gi[ti_l], gj[tj_l]),
+                                  neg1)
+                    loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
+                           jnp.where(loc[2] >= 0, loc[2], s))
+                new = jnp.where(mask, new, cur)
                 return lax.dynamic_update_slice(a_loc, new,
-                                                (sr, sc, zi, zi))
+                                                (sr, sc, zi, zi)), loc
 
-            a_loc = lax.cond(k < Nt - 1, trailing, lambda a: a, a_loc)
-            return a_loc, minpiv, minidx
+            a_loc, loc = lax.cond(k < Nt - 1, trailing, lambda x: x,
+                                  (a_loc, loc))
+            return a_loc, minpiv, minidx, rep, loc
 
         if S <= 0 or T <= 0:
             # no rank has trailing tiles only when k0 >= Nt (cannot happen)
             continue
-        a_loc, minpiv, minidx = lax.fori_loop(
-            k0, k1, super_step, (a_loc, minpiv, minidx))
+        a_loc, minpiv, minidx, rep, loc = lax.fori_loop(
+            k0, k1, super_step, (a_loc, minpiv, minidx, rep, loc))
 
-    return a_loc, minpiv, minidx
+    ldet = lax.psum(lax.psum(loc[0], AXIS_P), AXIS_Q)
+    lcor = lax.psum(lax.psum(loc[1], AXIS_P), AXIS_Q)
+    lsite = lax.pmax(lax.pmax(loc[2], AXIS_P), AXIS_Q)
+    adet = rep[0] + ldet
+    acor = rep[1] + lcor
+    asite = jnp.where(rep[2] >= 0, rep[2], lsite)
+    return a_loc, minpiv, minidx, adet, acor, asite
 
 
 def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
-               sb: int | None = None):
+               sb: int | None = None, abft: bool = False):
     """Factor the cyclic storage array of a Hermitian (lower) matrix in
     place: lower tiles of the result hold L.  ``n`` is the element dimension
     (for ragged last tiles); defaults to Nt*nb (exact tiling).  ``sb`` is
     the inner fori_loop span (default: ~SUPERBLOCKS compiled bodies).
 
-    Returns ``(data, minpiv, minidx)``: the factored storage plus the
-    smallest L-diagonal magnitude seen and its global element row
-    (replicated scalars feeding drivers/cholesky.py's HealthInfo; a NaN
-    diagonal — non-HPD leading minor — is recorded as a zero pivot)."""
+    Returns ``(data, minpiv, minidx, abft_detected, abft_corrected,
+    abft_site)``: the factored storage plus the smallest L-diagonal
+    magnitude seen and its global element row (replicated scalars feeding
+    drivers/cholesky.py's HealthInfo; a NaN diagonal — non-HPD leading
+    minor — is recorded as a zero pivot).  ``abft`` (static) turns on
+    Huang-Abraham checksum verification of the diagonal factor, the
+    broadcast panel and the trailing herk (robust/abft.py); the three
+    trailing int32 scalars are zero / -1 when off or clean."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     nb = data.shape[-1]
@@ -194,6 +276,8 @@ def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
     sb = sb if sb is not None else superblock(Nt)
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = shard_map_unchecked(
-        lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
-        mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P(), P()))
+        lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb,
+                               abft),
+        mesh=grid.mesh, in_specs=(spec,),
+        out_specs=(spec, P(), P(), P(), P(), P()))
     return fn(data)

@@ -43,6 +43,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
                               panel_lu_tournament)
+from ..robust import abft as _abft
 from ..robust import faults
 from ..util.compat_jax import shard_map_unchecked
 from .dist_chol import superblock
@@ -96,7 +97,7 @@ def _row_bundle_exchange(a_loc, out_rows, in_rows, p, r, nbundle):
 
 def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                       ib: int, sb: int, tau: float = 1.0, mpt: int = 4,
-                      depth: int = 2):
+                      depth: int = 2, abft: bool = False):
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
@@ -114,6 +115,15 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
     rdt = jnp.zeros((), dt).real.dtype
     minpiv = jnp.asarray(jnp.inf, rdt)
     minidx = jnp.zeros((), jnp.int32)
+    # ABFT counters, two accumulation disciplines (docs/ROBUSTNESS.md):
+    # ``rep`` counts checks of psum-REPLICATED data (the panel) — every
+    # rank computes the identical value, so it is never summed across the
+    # mesh.  ``loc`` counts checks each rank performs on its OWN tiles
+    # (U12 columns masked to the owner row, trailing tiles) and is
+    # psum'd over both axes once at the end.  (det, cor, site) int32.
+    neg1 = jnp.asarray(-1, jnp.int32)
+    rep = (zi, zi, neg1)
+    loc = (zi, zi, neg1)
 
     for k0 in range(0, Nt, sb):
         k1 = min(k0 + sb, Nt)
@@ -125,7 +135,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
 
         def super_step(k, carry, W0=W0, W=W, nbundle=nbundle, S=S, T=T,
                        k0=k0):
-            a_loc, perm_g, minpiv, minidx = carry
+            a_loc, perm_g, minpiv, minidx, rep, loc = carry
             rk, ck = k % p, k % q
             kkr = k // p
             vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
@@ -155,6 +165,15 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             else:
                 lu, perm = panel_lu(panel)
             lu = faults.maybe_corrupt("post_panel", lu)
+            if abft:
+                # verify L\U against the pre-factor panel's checksums
+                # (replicated data -> replicated counters).  Rolled row
+                # i0 is global element row k*nb + i0.
+                lu, det, cor, pi_, _ = _abft.lu_panel_check(
+                    panel, lu, perm, n_ctx=n)
+                ev = _abft.count_event(det, cor, k + pi_ // nb, k)
+                rep = (rep[0] + ev.detected, rep[1] + ev.corrected,
+                       jnp.where(rep[2] >= 0, rep[2], ev.site))
             lut = lu.reshape(W0, nb, nb)
 
             # ---- health trace: this step's U diagonal is diag(lut[0]);
@@ -195,7 +214,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 (zi, (k // q).astype(jnp.int32), zi, zi))
 
             def tail(carry):
-                a_loc, perm_g = carry
+                a_loc, perm_g, loc = carry
                 # ---- U12: row-k owners solve vs unit-lower L11, bcast ----
                 l11 = lut[0]
                 urow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
@@ -203,9 +222,41 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 u12 = jax.vmap(lambda t: lax.linalg.triangular_solve(
                     l11, t, left_side=True, lower=True,
                     unit_diagonal=True))(urow)
-                u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
-                u12 = lax.psum(u12, AXIS_P)      # all ranks, their own cols
                 gj_all = c + q * jnp.arange(ntl)
+                if abft:
+                    # R's checksums ride the SAME psum as the solved
+                    # tiles: the payload grows to [ntl, nb+1, nb+1] but
+                    # no collective round is added.  After the bcast
+                    # every rank re-verifies L11 @ U12 = R per local
+                    # column tile and repairs a single struck element.
+                    aug = jnp.zeros((ntl, nb + 1, nb + 1), dt)
+                    aug = aug.at[:, :nb, :nb].set(u12)
+                    aug = aug.at[:, :nb, nb].set(jnp.sum(urow, axis=2))
+                    aug = aug.at[:, nb, :nb].set(jnp.sum(urow, axis=1))
+                    aug = jnp.where(r == rk, aug, jnp.zeros_like(aug))
+                    aug = lax.psum(aug, AXIS_P)
+                    u12 = faults.maybe_corrupt("post_collective",
+                                               aug[:, :nb, :nb])
+                    r_row, r_col = aug[:, :nb, nb], aug[:, nb, :nb]
+                    u12, det_t, cor_t, _, _ = jax.vmap(
+                        lambda xx, rr, cc: _abft.left_product_check(
+                            l11, xx, rr, cc, unit=True,
+                            n_ctx=n))(u12, r_row, r_col)
+                    # count each global tile once: owner row rk only
+                    live = (gj_all > k) & (r == rk)
+                    det_n = jnp.sum(live & det_t, dtype=jnp.int32)
+                    cor_n = jnp.sum(live & cor_t, dtype=jnp.int32)
+                    tj_loc = jnp.argmax(live & det_t)
+                    s = jnp.where(
+                        det_n > 0,
+                        _abft.site_code(k, c + q * tj_loc),
+                        jnp.asarray(-1, jnp.int32))
+                    loc = (loc[0] + det_n, loc[1] + cor_n,
+                           jnp.where(loc[2] >= 0, loc[2], s))
+                else:
+                    u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
+                    u12 = lax.psum(u12, AXIS_P)  # all ranks, own cols
+                    u12 = faults.maybe_corrupt("post_collective", u12)
                 newrow = jnp.where((gj_all > k)[:, None, None], u12, urow)
                 row_sel = jnp.where(r == rk, newrow, urow)
                 a_loc = lax.dynamic_update_slice(
@@ -230,19 +281,45 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                                         (S, T, nb, nb))
                 mask = ((gi > k)[:, None, None, None] &
                         (gj > k)[None, :, None, None])
+                new = cur - upd
+                if abft:
+                    # per-tile checksum maintenance of the rank-local
+                    # GEMM (masked-out tiles have lrows/ucols zeroed, so
+                    # their expectation collapses to cur's own sums and
+                    # they verify clean by construction)
+                    exp_r = (jnp.sum(cur, axis=3)
+                             - _abft.tile_product_row_sums(
+                                 lrows[:, None], ucols[None]))
+                    exp_c = (jnp.sum(cur, axis=2)
+                             - _abft.tile_product_col_sums(
+                                 lrows[:, None], ucols[None]))
+                    new, ev, ti_l, tj_l = _abft.tile_sum_check(
+                        new, exp_r, exp_c, n_ctx=n)
+                    s = jnp.where(ev.detected > 0,
+                                  _abft.site_code(gi[ti_l], gj[tj_l]),
+                                  jnp.asarray(-1, jnp.int32))
+                    loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
+                           jnp.where(loc[2] >= 0, loc[2], s))
                 a_loc = lax.dynamic_update_slice(
-                    a_loc, jnp.where(mask, cur - upd, cur), (sr, sc, zi, zi))
-                return a_loc, perm_g
+                    a_loc, jnp.where(mask, new, cur), (sr, sc, zi, zi))
+                return a_loc, perm_g, loc
 
             if S > 0 and T > 0:
-                a_loc, perm_g = lax.cond(k < Nt - 1, tail,
-                                         lambda cr: cr, (a_loc, perm_g))
-            return a_loc, perm_g, minpiv, minidx
+                a_loc, perm_g, loc = lax.cond(k < Nt - 1, tail,
+                                              lambda cr: cr,
+                                              (a_loc, perm_g, loc))
+            return a_loc, perm_g, minpiv, minidx, rep, loc
 
-        a_loc, perm_g, minpiv, minidx = lax.fori_loop(
-            k0, k1, super_step, (a_loc, perm_g, minpiv, minidx))
+        a_loc, perm_g, minpiv, minidx, rep, loc = lax.fori_loop(
+            k0, k1, super_step, (a_loc, perm_g, minpiv, minidx, rep, loc))
 
-    return a_loc, perm_g[:m_pad], minpiv, minidx
+    ldet = lax.psum(lax.psum(loc[0], AXIS_P), AXIS_Q)
+    lcor = lax.psum(lax.psum(loc[1], AXIS_P), AXIS_Q)
+    lsite = lax.pmax(lax.pmax(loc[2], AXIS_P), AXIS_Q)
+    adet = rep[0] + ldet
+    acor = rep[1] + lcor
+    asite = jnp.where(rep[2] >= 0, rep[2], lsite)
+    return a_loc, perm_g[:m_pad], minpiv, minidx, adet, acor, asite
 
 
 def dist_permute_rows(b_data, perm, grid: Grid):
@@ -350,12 +427,18 @@ def dist_rbt_two_sided(data, u_levels, v_levels, grid: Grid, n: int):
 
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
                ib: int = 16, sb: int | None = None, tau: float = 1.0,
-               mpt: int = 4, depth: int = 2):
+               mpt: int = 4, depth: int = 2, abft: bool = False):
     """Factor square cyclic storage in place; returns
-    (data, perm, minpiv, minidx) with A[perm] = L @ U (perm over the
-    padded row space, identity on pads).  ``minpiv``/``minidx`` are the
-    smallest |U diagonal| encountered and its global element row —
-    replicated scalars feeding drivers/lu.py's HealthInfo.
+    (data, perm, minpiv, minidx, abft_detected, abft_corrected,
+    abft_site) with A[perm] = L @ U (perm over the padded row space,
+    identity on pads).  ``minpiv``/``minidx`` are the smallest
+    |U diagonal| encountered and its global element row — replicated
+    scalars feeding drivers/lu.py's HealthInfo.
+
+    ``abft`` (static) turns on Huang-Abraham checksum verification of
+    every panel, U12 bcast and trailing update (robust/abft.py): single
+    struck elements are repaired in place and counted in the three
+    trailing replicated int32 scalars (all zero / -1 when off or clean).
 
     ``tau`` (Option.PivotThreshold) < 1 switches the partial-pivot panel to
     threshold pivoting; ``mpt`` (Option.MaxPanelThreads) sizes the CALU
@@ -366,7 +449,7 @@ def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
     spec = P(AXIS_P, AXIS_Q, None, None)
     fn = shard_map_unchecked(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
-                                    method, ib, sb, tau, mpt, depth),
+                                    method, ib, sb, tau, mpt, depth, abft),
         mesh=grid.mesh, in_specs=(spec,),
-        out_specs=(spec, P(), P(), P()))
+        out_specs=(spec, P(), P(), P(), P(), P(), P()))
     return fn(data)

@@ -28,33 +28,86 @@ from jax.sharding import PartitionSpec as P
 from ..comm.collectives import bcast_from_col, bcast_from_row
 from ..core.grid import AXIS_P, AXIS_Q, Grid
 from ..internal.gemm import tile_outer_product
+from ..robust import abft as _abft
 from ..robust import faults
 
 
-def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int):
+def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int,
+                abft: bool = False):
     """Per-shard SUMMA body (runs inside shard_map).
 
     a_loc [mtl, ktl_a, mb, kb], b_loc [ktl_b, ntl, kb, nb],
     c_loc [mtl, ntl, mb, nb] — this shard's block-cyclic tiles.
+
+    ``abft`` carries Huang-Abraham checksums of the accumulator through
+    the k loop: the broadcast panels already ride the existing
+    collectives, so the expected row/column sums of ``sum_k A(:,k)
+    B(k,:)`` are accumulated locally at O(nb^2) per step — zero extra
+    communication.  After the loop the accumulator is verified tile by
+    tile and a single corrupted element is repaired in place
+    (robust/abft.py); returns ``(result, detected, corrected, site)``
+    with the counters psum-combined over the whole mesh.
     """
 
-    def body(k, acc):
+    def step(k):
         a_col = lax.dynamic_index_in_dim(a_loc, k // q, axis=1, keepdims=False)
         a_col = bcast_from_col(a_col, k % q)
         b_row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0, keepdims=False)
         b_row = bcast_from_row(b_row, k % p)
-        return acc + tile_outer_product(a_col, b_row)
+        return a_col, b_row
 
-    acc = lax.fori_loop(0, Kt, body, jnp.zeros_like(c_loc))
+    if not abft:
+        def body(k, acc):
+            a_col, b_row = step(k)
+            return acc + tile_outer_product(a_col, b_row)
+
+        acc = lax.fori_loop(0, Kt, body, jnp.zeros_like(c_loc))
+        acc = faults.maybe_corrupt("post_collective", acc)
+        return alpha * acc + beta * c_loc
+
+    mtl, ntl, mb, nb = c_loc.shape
+    kb = a_loc.shape[3]
+    dt = c_loc.dtype
+
+    def body(k, carry):
+        acc, rexp, cexp = carry
+        a_col, b_row = step(k)
+        acc = acc + tile_outer_product(a_col, b_row)
+        # checksum maintenance without forming the product:
+        # A (B e) and (e^T A) B per tile pair, O(tiles * nb^2)
+        rexp = rexp + _abft.tile_product_row_sums(a_col[:, None],
+                                                  b_row[None])
+        cexp = cexp + _abft.tile_product_col_sums(a_col[:, None],
+                                                  b_row[None])
+        return acc, rexp, cexp
+
+    acc, rexp, cexp = lax.fori_loop(
+        0, Kt, body, (jnp.zeros_like(c_loc),
+                      jnp.zeros((mtl, ntl, mb), dt),
+                      jnp.zeros((mtl, ntl, nb), dt)))
     acc = faults.maybe_corrupt("post_collective", acc)
-    return alpha * acc + beta * c_loc
+    acc, ev, ti_l, tj_l = _abft.tile_sum_check(acc, rexp, cexp,
+                                               n_ctx=Kt * kb)
+    r = lax.axis_index(AXIS_P)
+    c = lax.axis_index(AXIS_Q)
+    site_l = jnp.where(ev.detected > 0,
+                       _abft.site_code(r + p * ti_l, c + q * tj_l),
+                       jnp.asarray(-1, jnp.int32))
+    det = lax.psum(lax.psum(ev.detected, AXIS_P), AXIS_Q)
+    cor = lax.psum(lax.psum(ev.corrected, AXIS_P), AXIS_Q)
+    site = lax.pmax(lax.pmax(site_l, AXIS_P), AXIS_Q)
+    return alpha * acc + beta * c_loc, det, cor, site
 
 
-def summa_gemm_data(a_data, b_data, c_data, alpha, beta, Kt, grid: Grid):
-    """shard_map wrapper over the cyclic storage arrays."""
+def summa_gemm_data(a_data, b_data, c_data, alpha, beta, Kt, grid: Grid,
+                    abft: bool = False):
+    """shard_map wrapper over the cyclic storage arrays.  With ``abft``
+    returns ``(data, detected, corrected, site)`` — the extra outputs
+    are fully replicated scalars."""
     spec = P(AXIS_P, AXIS_Q, None, None)
+    out_specs = (spec, P(), P(), P()) if abft else spec
     fn = jax.shard_map(
         lambda a, b, c: summa_local(a, b, c, alpha, beta, Kt,
-                                    grid.p, grid.q),
-        mesh=grid.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                                    grid.p, grid.q, abft=abft),
+        mesh=grid.mesh, in_specs=(spec, spec, spec), out_specs=out_specs)
     return fn(a_data, b_data, c_data)

@@ -1,0 +1,413 @@
+"""Algorithm-based fault tolerance: Huang-Abraham checksums for the
+blocked BLAS-3 / LU / Cholesky paths — detect, locate, correct.
+
+The classic ABFT invariant: augment ``A`` with a row-checksum vector
+``A e`` and a column-checksum vector ``e^T A`` (``e`` the all-ones
+vector).  Matrix products and blocked factorization steps map checksums
+to checksums — ``(L U) e = L (U e)``, ``e^T (A - L21 U12) =
+e^T A - (e^T L21) U12`` — at O(n^2) cost per step, against the O(n^3)
+compute they shadow.  A single corrupted element delta at ``(i0, j0)``
+leaves a CROSS pattern in the residuals: one spiked entry in the row
+residual (locating ``i0``), one in the column residual (locating
+``j0``), and the element is reconstructed from either checksum's masked
+complement — which works for ``nan``/``inf`` payloads too, where the
+corrupted value itself is unusable.  Every correction is re-verified:
+a multi-element strike that fools the locator fails the re-check and is
+reported detected-but-uncorrected, which the health layer turns into an
+escalation (docs/ROBUSTNESS.md).
+
+This module is pure mechanism, mirroring internal/rbt.py's discipline:
+no options, no policies, no exceptions — every function returns arrays
+plus :class:`AbftCounts`, and the driver boundary folds those into
+``HealthInfo`` (robust/health.py).  Everything is jit/shard_map-safe:
+locations are argmaxes over boolean masks, corrections are
+``jnp.where``-gated scatters, and thresholds reuse certify.py's
+dtype-calibrated tolerance family scaled by the operands' magnitude.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..internal.gemm import (tile_product_col_sums,  # noqa: F401
+                             tile_product_row_sums)
+from .certify import tolerance
+
+
+class AbftState(NamedTuple):
+    """Checksum pair for one matrix: ``row = A e`` (length m) and
+    ``col = e^T A`` (length n).  A jit-safe pytree of two vectors."""
+
+    row: jax.Array
+    col: jax.Array
+
+
+class AbftCounts(NamedTuple):
+    """Detection bookkeeping for one or more checksum verifications.
+
+    detected   int32 — verification events that found a mismatch
+    corrected  int32 — of those, repaired in place (re-verified)
+    site       int32 — ``ti * 65536 + tj`` of the first located tile,
+               -1 when nothing was detected
+    """
+
+    detected: jax.Array
+    corrected: jax.Array
+    site: jax.Array
+
+
+def zero_counts() -> AbftCounts:
+    return AbftCounts(jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                      jnp.asarray(-1, jnp.int32))
+
+
+def site_code(ti, tj):
+    """Encode a global tile coordinate into the int32 HealthInfo site."""
+    return (jnp.asarray(ti, jnp.int32) * 65536
+            + jnp.asarray(tj, jnp.int32))
+
+
+def count_event(detected, corrected, ti, tj) -> AbftCounts:
+    """Counts for ONE verification event at (possibly traced) tile
+    coordinates; the site is recorded only when something was detected."""
+    det = jnp.asarray(detected)
+    return AbftCounts(
+        det.astype(jnp.int32),
+        jnp.asarray(corrected).astype(jnp.int32),
+        jnp.where(det, site_code(ti, tj), jnp.asarray(-1, jnp.int32)))
+
+
+def add_counts(a: AbftCounts, b: AbftCounts) -> AbftCounts:
+    """Accumulate events: counters sum, the first located site wins."""
+    return AbftCounts(a.detected + b.detected,
+                      a.corrected + b.corrected,
+                      jnp.where(a.site >= 0, a.site, b.site))
+
+
+def checksums(a) -> AbftState:
+    """The Huang-Abraham pair for a dense matrix."""
+    a = jnp.asarray(a)
+    return AbftState(row=jnp.sum(a, axis=-1), col=jnp.sum(a, axis=-2))
+
+
+# ---------------------------------------------------------------- utils
+
+def _threshold(dtype, n: int, *mags):
+    """Detection threshold: certify's dtype-calibrated ``50 n eps``
+    scaled by the participating magnitudes (each clamped below at 1 so a
+    zero block never yields a zero threshold).  Legitimate rounding in an
+    n-term checksum reduction sits orders of magnitude below this; a
+    2^100 bitflip sits ~80 orders above."""
+    t = jnp.asarray(tolerance(dtype, n), jnp.finfo(dtype).dtype)
+    for m in mags:
+        t = t * jnp.maximum(jnp.asarray(m).real.astype(t.dtype), 1.0)
+    return t
+
+
+def _amax(x):
+    return jnp.max(jnp.abs(x)) if x.size else jnp.asarray(0.0)
+
+
+def _finite_amax(x):
+    """max |x| over finite entries only — the magnitude scale of a block
+    that may carry an injected NaN/Inf payload."""
+    a = jnp.abs(x)
+    return jnp.max(jnp.where(jnp.isfinite(a), a, 0.0))
+
+
+def _bad(d, t):
+    """Residual-exceeds-threshold mask; NaN/Inf residuals count as bad."""
+    return ~(jnp.abs(d) <= t)
+
+
+def _score(d):
+    """Magnitude for argmax localization with NaN/Inf forced to the top."""
+    a = jnp.abs(d)
+    return jnp.where(jnp.isfinite(a), a, jnp.inf)
+
+
+def _excl_sum(v, skip):
+    """Sum of 1D ``v`` excluding (traced) index ``skip`` — the masked
+    complement a corrupted element is reconstructed from."""
+    return jnp.sum(jnp.where(jnp.arange(v.shape[0]) != skip, v, 0))
+
+
+def _nf_locate(x):
+    """(any_nonfinite, i, j) of the first non-finite element of 2D ``x``.
+
+    A NaN/Inf payload poisons every residual it touches (``NaN * 0 =
+    NaN``), so the cross-pattern's first-bad-index locate degenerates —
+    the element must be found from the factor's own non-finite mask.
+    Finite payloads (bitflip) keep the zeros in the spread direction and
+    are located from the residual pattern instead."""
+    nf = ~jnp.isfinite(jnp.abs(x))
+    flat = jnp.argmax(nf.reshape(-1))
+    return (jnp.any(nf), (flat // x.shape[1]).astype(jnp.int32),
+            (flat % x.shape[1]).astype(jnp.int32))
+
+
+# -------------------------------------------- additive (GEMM) checksums
+
+def sum_check(x, exp_row, exp_col, *, dtype=None, n_ctx=None,
+              nb=1, row0=0, col0=0):
+    """Verify a dense block against expected checksums; correct a single
+    corrupted element.
+
+    ``x`` [m, n] should satisfy ``x @ e == exp_row`` and ``e^T x ==
+    exp_col`` up to rounding.  A single corrupted element produces
+    exactly one bad row-residual entry and one bad column-residual entry
+    (the cross pattern); the true value is rebuilt from the row
+    checksum's masked complement and cross-checked against the column's.
+    Anything wider (two elements, two tiles) fails the single-spike /
+    consistency gates and is left untouched.
+
+    Returns ``(x', AbftCounts)`` with the site mapped to global tile
+    coordinates via ``nb`` / ``row0`` / ``col0``.
+    """
+    x = jnp.asarray(x)
+    dtype = dtype or x.dtype
+    n_ctx = n_ctx or max(x.shape)
+    dr = jnp.sum(x, axis=1) - exp_row
+    dc = jnp.sum(x, axis=0) - exp_col
+    t = _threshold(dtype, n_ctx, _amax(exp_row), _amax(exp_col))
+    bad_r, bad_c = _bad(dr, t), _bad(dc, t)
+    detected = jnp.any(bad_r) | jnp.any(bad_c)
+    i0 = jnp.argmax(_score(dr)).astype(jnp.int32)
+    j0 = jnp.argmax(_score(dc)).astype(jnp.int32)
+    # reconstruct from the row complement, cross-check with the column's
+    v_r = exp_row[i0] - _excl_sum(x[i0, :], j0)
+    v_c = exp_col[j0] - _excl_sum(x[:, j0], i0)
+    consistent = jnp.abs(v_r - v_c) <= t
+    corrected = (detected & (jnp.sum(bad_r) == 1) & (jnp.sum(bad_c) == 1)
+                 & consistent)
+    x = x.at[i0, j0].set(jnp.where(corrected, v_r, x[i0, j0]))
+    counts = count_event(detected, corrected,
+                         (row0 + i0) // nb, (col0 + j0) // nb)
+    return x, counts
+
+
+def tile_sum_check(t4, exp_r, exp_c, *, dtype=None, n_ctx=None):
+    """Tile-granular :func:`sum_check` for a 4D tile array [S, T, mb, nb]
+    with per-tile expected row sums ``exp_r`` [S, T, mb] and column sums
+    ``exp_c`` [S, T, nb].  Locates the worst tile, corrects a single
+    corrupted element inside it, and refuses (detected-but-uncorrected)
+    when more than one tile — or more than one element — is implicated.
+
+    Returns ``(t4', AbftCounts-with-LOCAL-tile-site, ti, tj)`` so mesh
+    callers can remap the local tile index to global coordinates."""
+    t4 = jnp.asarray(t4)
+    S, T, mb, nb = t4.shape
+    dtype = dtype or t4.dtype
+    n_ctx = n_ctx or max(S * mb, T * nb)
+    dr = jnp.sum(t4, axis=3) - exp_r                      # [S, T, mb]
+    dc = jnp.sum(t4, axis=2) - exp_c                      # [S, T, nb]
+    t = _threshold(dtype, n_ctx, _amax(exp_r), _amax(exp_c))
+    bad_r, bad_c = _bad(dr, t), _bad(dc, t)
+    tile_bad = jnp.any(bad_r, axis=2) | jnp.any(bad_c, axis=2)  # [S, T]
+    detected = jnp.any(tile_bad)
+    n_tiles_bad = jnp.sum(tile_bad)
+    tile_score = (jnp.max(_score(dr), axis=2)
+                  + jnp.max(_score(dc), axis=2))
+    flat = jnp.argmax(tile_score.reshape(-1))
+    ti, tj = (flat // T).astype(jnp.int32), (flat % T).astype(jnp.int32)
+    sub = t4[ti, tj]                                       # [mb, nb]
+    sub_dr, sub_dc = dr[ti, tj], dc[ti, tj]
+    i0 = jnp.argmax(_score(sub_dr)).astype(jnp.int32)
+    j0 = jnp.argmax(_score(sub_dc)).astype(jnp.int32)
+    v_r = exp_r[ti, tj, i0] - _excl_sum(sub[i0, :], j0)
+    v_c = exp_c[ti, tj, j0] - _excl_sum(sub[:, j0], i0)
+    corrected = (detected & (n_tiles_bad == 1)
+                 & (jnp.sum(_bad(sub_dr, t)) == 1)
+                 & (jnp.sum(_bad(sub_dc, t)) == 1)
+                 & (jnp.abs(v_r - v_c) <= t))
+    sub = sub.at[i0, j0].set(jnp.where(corrected, v_r, sub[i0, j0]))
+    t4 = t4.at[ti, tj].set(sub)
+    return t4, count_event(detected, corrected, ti, tj), ti, tj
+
+
+# ------------------------------------------------------ LU panel check
+
+def _lu_panel_resid(pan_row_p, pan_col, lu):
+    """Checksum residuals of a packed LU panel against its PRE-factor
+    input: ``dr = L (U e) - rowsum(pan)[perm]`` and ``dc = (e^T L) U -
+    colsum(pan)`` — O(M w), no product formed.  ``L`` is the implicit
+    unit-lower factor, ``U`` the upper part of the first w rows."""
+    M, w = lu.shape
+    l_strict = jnp.tril(lu, -1) if M == w else \
+        jnp.where(jnp.arange(M)[:, None] > jnp.arange(w)[None, :], lu, 0)
+    u = jnp.triu(lu[:w])
+    ru = jnp.sum(u, axis=1)                                # U e, [w]
+    cl = 1.0 + jnp.sum(l_strict, axis=0)                   # e^T L, [w]
+    act_row = l_strict @ ru
+    act_row = act_row.at[:w].add(ru)                       # unit diagonal
+    dr = act_row - pan_row_p
+    dc = cl @ u - pan_col
+    return dr, dc, u, ru, cl, l_strict
+
+
+def lu_panel_check(pan, lu, perm, *, n_ctx=None):
+    """Verify a just-factored packed panel ``lu`` (= L\\U, [M, w], unit
+    L implicit) against its pre-factor input ``pan`` and permutation
+    ``perm`` (``pan[perm] = L U``); locate + correct one corrupted
+    factor element.
+
+    Column sums are invariant under the row permutation and row sums are
+    permutation-equivariant, so both checks need only the checksum
+    vectors of ``pan``.  A strike in the L part (i0 > j0) spikes exactly
+    one row residual and spreads along U's row j0 in the column
+    residual; a strike in the U part spikes exactly one column residual
+    and spreads along L's column i0 — either way ``(first bad row,
+    first bad column)`` is the element.  Reconstruction solves the
+    element's own checksum identity with the corrupted entry masked out
+    (NaN/Inf-proof), and the panel is re-verified before the correction
+    is accepted.
+
+    Returns ``(lu', AbftCounts-with-LOCAL-element-site-unset, i0, j0)``
+    — the caller maps the element to its global tile."""
+    pan = jnp.asarray(pan)
+    lu = jnp.asarray(lu)
+    M, w = lu.shape
+    n_ctx = n_ctx or M
+    pan_row_p = jnp.sum(pan, axis=1)[perm]
+    pan_col = jnp.sum(pan, axis=0)
+    dr, dc, u, ru, cl, l_strict = _lu_panel_resid(pan_row_p, pan_col, lu)
+    t = _threshold(lu.dtype, n_ctx, _amax(pan), _finite_amax(lu))
+    bad_r, bad_c = _bad(dr, t), _bad(dc, t)
+    detected = jnp.any(bad_r) | jnp.any(bad_c)
+    any_nf, nf_i, nf_j = _nf_locate(lu)
+    i0 = jnp.where(any_nf, nf_i, jnp.argmax(bad_r).astype(jnp.int32))
+    j0 = jnp.where(any_nf, nf_j, jnp.argmax(bad_c).astype(jnp.int32))
+    is_l = i0 > j0
+    rows = jnp.arange(M)
+    cols = jnp.arange(w)
+    # --- L-part reconstruction: column j0's checksum identity.
+    # true (e^T L)[j0] = (colsum(pan)[j0] - sum_{i<j0} (e^T L)[i] U[i,j0])
+    #                    / U[j0,j0]; the strike is the only unknown term.
+    num = pan_col[j0] - jnp.sum(jnp.where(cols < j0, cl * u[:, j0], 0))
+    den = u[j0, j0]
+    cl_true = num / jnp.where(den == 0, 1.0, den)
+    col_j0 = jnp.where((rows > j0) & (rows != i0), lu[:, j0], 0)
+    v_l = cl_true - 1.0 - jnp.sum(col_j0)
+    # --- U-part reconstruction: row i0's checksum identity.
+    # true (U e)[i0] = rowsum(pan)[perm][i0] - sum_{j<i0} L[i0,j] (U e)[j]
+    ru_true = pan_row_p[i0] - jnp.sum(
+        jnp.where(cols < i0, lu[i0, :] * ru, 0))
+    row_i0 = jnp.where((cols >= i0) & (cols != j0), lu[i0, :], 0)
+    v_u = ru_true - jnp.sum(row_i0)
+    v = jnp.where(is_l, v_l, v_u)
+    lu_fix = lu.at[i0, j0].set(v)
+    dr2, dc2, *_ = _lu_panel_resid(pan_row_p, pan_col, lu_fix)
+    clean2 = ~(jnp.any(_bad(dr2, t)) | jnp.any(_bad(dc2, t)))
+    corrected = detected & clean2
+    out = jnp.where(corrected, lu_fix, lu)
+    return out, detected, corrected, i0, j0
+
+
+# ------------------------------------------------- Cholesky tile check
+
+def chol_tile_check(hh, lkk, *, n_ctx=None):
+    """Verify a just-factored diagonal tile ``lkk`` (lower triangular)
+    against the Hermitian tile ``hh`` it factored; locate + correct one
+    corrupted factor element.
+
+    The product ``L L^H`` is Hermitian, so its row/column checksum
+    residuals are conjugate mirrors and carry no cross information —
+    instead the full tile residual ``E = tril(L L^H - H)`` is formed at
+    O(nb^3), the cost of the tile factorization itself and noise next to
+    the O(n^2 nb) trailing update it guards.  A single strike at
+    ``(i0, j0)`` confines E's support to row i0 (columns >= j0) and
+    column i0, so (first bad row, first bad column) of E locates it; the
+    element is rebuilt from its own Cholesky defining equation —
+    forward-substitution of row i0 against H — and the tile re-verified.
+
+    Returns ``(lkk', detected, corrected)``."""
+    hh = jnp.asarray(hh)
+    lkk = jnp.asarray(lkk)
+    nb = lkk.shape[0]
+    n_ctx = n_ctx or nb
+    tril_m = jnp.tril(jnp.ones((nb, nb), bool))
+
+    def resid(l):
+        lo = jnp.tril(l)
+        e = lo @ jnp.conj(lo).T - hh
+        return jnp.where(tril_m, e, 0)
+
+    e1 = resid(lkk)
+    t = _threshold(lkk.dtype, n_ctx, _amax(hh))
+    bad = _bad(e1, t)
+    detected = jnp.any(bad)
+    any_nf, nf_i, nf_j = _nf_locate(jnp.tril(lkk))
+    i0 = jnp.where(any_nf, nf_i,
+                   jnp.argmax(jnp.any(bad, axis=1)).astype(jnp.int32))
+    j0 = jnp.where(any_nf, nf_j,
+                   jnp.argmax(jnp.any(bad, axis=0)).astype(jnp.int32))
+    cols = jnp.arange(nb)
+    # row-i0 forward substitution with the struck element masked out:
+    # H[i0,j0] = sum_{k<j0} L[i0,k] conj(L[j0,k]) + L[i0,j0] conj(L[j0,j0])
+    part = jnp.sum(jnp.where(cols < j0, lkk[i0, :] * jnp.conj(lkk[j0, :]),
+                             0))
+    den = jnp.conj(lkk[j0, j0])
+    v_off = (hh[i0, j0] - part) / jnp.where(den == 0, 1.0, den)
+    # diagonal strike: L[i0,i0] = sqrt(H[i0,i0] - sum_{k<i0} |L[i0,k]|^2)
+    d2 = (hh[i0, i0] - jnp.sum(jnp.where(
+        cols < i0, jnp.abs(lkk[i0, :]) ** 2, 0))).real
+    v_diag = jnp.sqrt(jnp.maximum(d2, 0)).astype(lkk.dtype)
+    v = jnp.where(i0 == j0, v_diag, v_off)
+    lkk_fix = lkk.at[i0, j0].set(v)
+    clean2 = ~jnp.any(_bad(resid(lkk_fix), t))
+    corrected = detected & clean2
+    return jnp.where(corrected, lkk_fix, lkk), detected, corrected
+
+
+# -------------------------------------- triangular product (TRSM) check
+
+def _left_product_resid(lmat, x, r_row, r_col, unit):
+    m = lmat.shape[0]
+    lo = jnp.tril(lmat, -1) if unit else jnp.tril(lmat)
+    cl = jnp.sum(lo, axis=0) + (1.0 if unit else 0.0)      # e^T L
+    xe = jnp.sum(x, axis=1)
+    act_row = lo @ xe + (xe if unit else 0.0)
+    dr = act_row - r_row
+    dc = cl @ x - r_col
+    return dr, dc
+
+
+def left_product_check(lmat, x, r_row, r_col, *, unit, n_ctx=None):
+    """Verify ``L @ X == R`` through R's checksums only (``r_row = R e``,
+    ``r_col = e^T R``) and correct one corrupted element of X.  L is
+    lower triangular ([m, m], unit optional), so a strike at (i0, j0)
+    spikes the row residual first at i0 (L's column i0 starts at its
+    nonzero diagonal) and the column residual exactly at j0.  The row's
+    own identity is solved for the true row sum, then the element —
+    masked sums throughout, so NaN/Inf payloads reconstruct too.
+
+    Works with just the checksum vectors of R, which is what rides the
+    mesh collectives (dist_lu's U12 psum): no extra communication beyond
+    the checksum rows.  Returns ``(x', detected, corrected, i0, j0)``."""
+    lmat = jnp.asarray(lmat)
+    x = jnp.asarray(x)
+    m, ncol = x.shape
+    n_ctx = n_ctx or max(m, ncol)
+    dr, dc = _left_product_resid(lmat, x, r_row, r_col, unit)
+    t = _threshold(x.dtype, n_ctx, _amax(r_row), _amax(r_col),
+                   _finite_amax(x))
+    bad_r, bad_c = _bad(dr, t), _bad(dc, t)
+    detected = jnp.any(bad_r) | jnp.any(bad_c)
+    any_nf, nf_i, nf_j = _nf_locate(x)
+    i0 = jnp.where(any_nf, nf_i, jnp.argmax(bad_r).astype(jnp.int32))
+    j0 = jnp.where(any_nf, nf_j, jnp.argmax(bad_c).astype(jnp.int32))
+    rows = jnp.arange(m)
+    xe = jnp.sum(x, axis=1)
+    den = jnp.asarray(1.0, x.dtype) if unit else lmat[i0, i0]
+    # mask AFTER the product: xe[i0] may be NaN and 0 * NaN = NaN
+    xe_true = (r_row[i0] - jnp.sum(jnp.where(
+        rows < i0, lmat[i0, :] * xe, 0))) / jnp.where(den == 0, 1.0, den)
+    v = xe_true - _excl_sum(x[i0, :], j0)
+    x_fix = x.at[i0, j0].set(v)
+    dr2, dc2 = _left_product_resid(lmat, x_fix, r_row, r_col, unit)
+    clean2 = ~(jnp.any(_bad(dr2, t)) | jnp.any(_bad(dc2, t)))
+    corrected = detected & clean2
+    return jnp.where(corrected, x_fix, x), detected, corrected, i0, j0

@@ -18,8 +18,8 @@ Named sites (see docs/ROBUSTNESS.md):
 =================  =====================================================
 ``input``          driver inputs (A's tiles) before factorization
 ``post_panel``     a just-factored panel, before the trailing update
-``post_collective`` a collective result (SUMMA accumulator, broadcast
-                   X row in the distributed trsm sweep)
+``post_collective`` a collective result (SUMMA accumulator, the psum'd
+                   U12 row in dist_lu, the broadcast panel in dist_chol)
 ``solve``          the computed solution X
 ``post_stage1``    the band matrix produced by stage 1 of the two-stage
                    reductions (he2hb / ge2tb), before stage 2 consumes it
@@ -36,14 +36,26 @@ Named sites (see docs/ROBUSTNESS.md):
 
 Payloads: ``nan``, ``inf``, and ``bitflip`` — a high-exponent-bit flip
 (value scaled by 2^100), the silent-data-corruption payload that stays
-FINITE and is only caught by pivot-growth / residual checks.
+FINITE and is only caught by pivot-growth / residual / checksum checks.
 
 Plans are PERSISTENT by default: the corruption re-fires every time the
 site is reached while the plan is active (a stuck-at fault).  Pass
-``transient=True`` for single-shot SDC semantics — the plan deactivates
-after its first strike, so a recovery retry (e.g. heev escalating
-Auto -> DC -> QR) sees clean data on the second attempt, which is exactly
-how a transient bit-flip behaves in production.
+``transient=True`` for single-shot SDC semantics: the strike fires at most
+once per :func:`inject` activation, decided at RUN time through an ordered
+host callback — so a shape/dtype retrace of the same jitted driver inside
+one ``inject`` block neither re-fires the strike nor loses it, and a
+recovery retry (e.g. heev escalating Auto -> DC -> QR) sees clean data on
+the second attempt, which is exactly how a transient bit-flip behaves in
+production.
+
+Strikes can be confined to one tile of the site's array with
+``FaultPlan(tile=(i, j), nb=...)``: for 4D tile arrays ``[.., .., mb, nb]``
+the strike lands inside ``x[i, j]``; for 3D tile stacks ``[T, mb, nb]``
+inside ``x[i]``; for 2D arrays inside the ``nb x nb`` block at block-row
+``i``, block-column ``j`` (``nb`` required).  A tile index outside the
+array is a miss (no-op) — a persistent plan aimed at the last panel tile
+therefore lands exactly once across a blocked factorization's shrinking
+panels.  ``tile=None`` keeps the whole-array behavior.
 """
 
 from __future__ import annotations
@@ -53,6 +65,8 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
 
 SITES = ("input", "post_panel", "post_collective", "solve",
          "post_stage1", "post_chase", "post_secular", "post_backtransform",
@@ -72,9 +86,13 @@ class FaultPlan:
     kind: str = "nan"
     seed: int = 0
     count: int = 1
-    # transient faults strike once and deactivate (single-shot SDC);
-    # the default is a stuck-at fault that re-fires on every pass.
+    # transient faults strike once per inject() activation (single-shot
+    # SDC); the default is a stuck-at fault that re-fires on every pass.
     transient: bool = False
+    # confine the strike to one tile: (block-row, block-col), or None for
+    # the whole array.  ``nb`` gives the block edge for 2D arrays.
+    tile: tuple[int, int] | None = None
+    nb: int = 0
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -83,27 +101,60 @@ class FaultPlan:
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"kinds: {KINDS}")
+        if self.tile is not None:
+            if (len(self.tile) != 2
+                    or any(int(t) != t or t < 0 for t in self.tile)):
+                raise ValueError(f"tile must be two non-negative block "
+                                 f"indices, got {self.tile!r}")
 
 
 _ACTIVE: dict[str, FaultPlan] = {}
+# per-inject() activation bookkeeping for transient plans: which
+# activation a site's plan belongs to, and which (activation, site) pairs
+# have already struck.  Consumption is recorded at RUN time (io_callback),
+# so retraces of the same driver share one consumption record.
+_EPOCH = 0
+_PLAN_EPOCH: dict[str, int] = {}
+_SPENT: set[tuple[int, str]] = set()
 
 
 @contextlib.contextmanager
 def inject(*plans: FaultPlan):
     """Activate fault plans for the dynamic extent of the block.  Traced
     computations pick up the corruption only if traced inside."""
+    global _EPOCH
     saved = dict(_ACTIVE)
+    saved_epoch = dict(_PLAN_EPOCH)
+    _EPOCH += 1
+    epoch = _EPOCH
     try:
         for p in plans:
             _ACTIVE[p.site] = p
+            _PLAN_EPOCH[p.site] = epoch
         yield
     finally:
         _ACTIVE.clear()
         _ACTIVE.update(saved)
+        _PLAN_EPOCH.clear()
+        _PLAN_EPOCH.update(saved_epoch)
+        _SPENT.difference_update({k for k in _SPENT if k[0] == epoch})
 
 
 def active(site: str) -> FaultPlan | None:
     return _ACTIVE.get(site)
+
+
+def _strike_flat(flat, size: int, plan: FaultPlan):
+    """Corrupt ``plan.count`` deterministic positions of a flat array."""
+    k = min(plan.count, size)
+    idx = jnp.asarray(np.random.default_rng(plan.seed).choice(
+        size, size=k, replace=False))
+    if plan.kind == "nan":
+        return flat.at[idx].set(jnp.nan)
+    if plan.kind == "inf":
+        return flat.at[idx].set(jnp.inf)
+    # bitflip: exponent-bit flip — finite but wildly wrong
+    return flat.at[idx].multiply(_BITFLIP_SCALE)
 
 
 def corrupt(x, plan: FaultPlan):
@@ -113,30 +164,68 @@ def corrupt(x, plan: FaultPlan):
     Positions are drawn with HOST numpy at trace time (seed, count and
     x.size are all static), so the corruption lowers to constant-index
     scatters — no jax.random traffic inside jit/shard_map, where this
-    jax's replication checker rejects the shuffle primitives."""
-    import numpy as np
+    jax's replication checker rejects the shuffle primitives.
+
+    With ``plan.tile`` set, the strike is confined to that tile of ``x``
+    (see module docstring); an out-of-range tile index is a miss."""
     x = jnp.asarray(x)
     if x.size == 0 or not jnp.issubdtype(x.dtype, jnp.inexact):
         return x
-    k = min(plan.count, x.size)
-    idx = jnp.asarray(np.random.default_rng(plan.seed).choice(
-        x.size, size=k, replace=False))
-    flat = x.reshape(-1)
-    if plan.kind == "nan":
-        flat = flat.at[idx].set(jnp.nan)
-    elif plan.kind == "inf":
-        flat = flat.at[idx].set(jnp.inf)
-    else:  # bitflip: exponent-bit flip — finite but wildly wrong
-        flat = flat.at[idx].multiply(_BITFLIP_SCALE)
-    return flat.reshape(x.shape)
+    if plan.tile is None:
+        flat = _strike_flat(x.reshape(-1), x.size, plan)
+        return flat.reshape(x.shape)
+    ti, tj = plan.tile
+    if x.ndim == 4:
+        if ti >= x.shape[0] or tj >= x.shape[1]:
+            return x
+        sub = x[ti, tj]
+        sub = _strike_flat(sub.reshape(-1), sub.size, plan).reshape(sub.shape)
+        return x.at[ti, tj].set(sub)
+    if x.ndim == 3:
+        if ti >= x.shape[0]:
+            return x
+        sub = x[ti]
+        sub = _strike_flat(sub.reshape(-1), sub.size, plan).reshape(sub.shape)
+        return x.at[ti].set(sub)
+    if x.ndim == 2:
+        if plan.nb <= 0:
+            raise ValueError("FaultPlan.tile on a 2D array requires nb > 0")
+        r0, c0 = ti * plan.nb, tj * plan.nb
+        if r0 >= x.shape[0] or c0 >= x.shape[1]:
+            return x
+        sub = x[r0:r0 + plan.nb, c0:c0 + plan.nb]
+        sub = _strike_flat(sub.reshape(-1), sub.size, plan).reshape(sub.shape)
+        return x.at[r0:r0 + sub.shape[0], c0:c0 + sub.shape[1]].set(sub)
+    raise ValueError(f"FaultPlan.tile targeting needs a 2D/3D/4D array, "
+                     f"got ndim={x.ndim}")
 
 
 def maybe_corrupt(site: str, x):
     """The site hook drivers call: identity unless a plan is active.
-    A ``transient`` plan deactivates after its first strike."""
+
+    A ``transient`` plan strikes at most once per :func:`inject`
+    activation.  Consumption is decided when the computation RUNS, not
+    when it is traced: the corrupted and clean values are both woven into
+    the trace and an ordered host callback picks one per execution.  A
+    retrace under the same activation therefore cannot re-fire a spent
+    strike, and tracing at a throwaway shape cannot eat the strike meant
+    for the real one."""
     plan = _ACTIVE.get(site)
     if plan is None:
         return x
-    if plan.transient:
-        del _ACTIVE[site]
-    return corrupt(x, plan)
+    if not plan.transient:
+        return corrupt(x, plan)
+    x = jnp.asarray(x)
+    if x.size == 0 or not jnp.issubdtype(x.dtype, jnp.inexact):
+        return x
+    epoch = _PLAN_EPOCH.get(site, 0)
+
+    def _consume():
+        if _PLAN_EPOCH.get(site) != epoch or (epoch, site) in _SPENT:
+            return np.asarray(False)
+        _SPENT.add((epoch, site))
+        return np.asarray(True)
+
+    fire = io_callback(_consume, jax.ShapeDtypeStruct((), np.bool_),
+                       ordered=True)
+    return jnp.where(fire, corrupt(x, plan), x)

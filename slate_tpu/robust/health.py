@@ -34,6 +34,11 @@ class HealthInfo(NamedTuple):
                      escalation keys on (1.0 when not tracked)
     iters            int32 refinement iterations (0 for direct solves)
     converged        bool — iterative convergence (True for direct paths)
+    abft_detected    int32 — checksum-verification events that found a
+                     mismatch (robust/abft.py; 0 when ABFT is off)
+    abft_corrected   int32 — of those, how many were repaired in place
+    abft_site        int32 — located global tile of the FIRST detection,
+                     encoded ``ti * 65536 + tj``; -1 when none
     """
 
     nonfinite: jax.Array
@@ -43,22 +48,34 @@ class HealthInfo(NamedTuple):
     growth: jax.Array
     iters: jax.Array
     converged: jax.Array
+    abft_detected: jax.Array = jnp.asarray(0, jnp.int32)
+    abft_corrected: jax.Array = jnp.asarray(0, jnp.int32)
+    abft_site: jax.Array = jnp.asarray(-1, jnp.int32)
 
     @property
     def ok(self):
-        """Scalar bool: no failure flag set (still traced under jit)."""
-        return (~self.nonfinite) & (self.info == 0) & self.converged
+        """Scalar bool: no failure flag set (still traced under jit).
+        A detected-but-uncorrected checksum mismatch is a failure."""
+        return ((~self.nonfinite) & (self.info == 0) & self.converged
+                & (self.abft_detected == self.abft_corrected))
 
     def is_traced(self) -> bool:
         return any(isinstance(x, jax.core.Tracer) for x in self)
 
     def describe(self) -> str:
         """Eager-only human summary (used in exception messages)."""
-        return (f"info={int(self.info)} nonfinite={bool(self.nonfinite)} "
-                f"min_pivot={float(self.min_pivot):.3e}"
-                f"@{int(self.min_pivot_index)} "
-                f"growth={float(self.growth):.3e} iters={int(self.iters)} "
-                f"converged={bool(self.converged)}")
+        s = (f"info={int(self.info)} nonfinite={bool(self.nonfinite)} "
+             f"min_pivot={float(self.min_pivot):.3e}"
+             f"@{int(self.min_pivot_index)} "
+             f"growth={float(self.growth):.3e} iters={int(self.iters)} "
+             f"converged={bool(self.converged)}")
+        if int(self.abft_detected) or int(self.abft_corrected):
+            site = int(self.abft_site)
+            where = (f"tile({site >> 16},{site & 0xffff})" if site >= 0
+                     else "unlocated")
+            s += (f" abft={int(self.abft_corrected)}/"
+                  f"{int(self.abft_detected)}@{where}")
+        return s
 
 
 def healthy(dtype=jnp.float64) -> HealthInfo:
@@ -72,6 +89,9 @@ def healthy(dtype=jnp.float64) -> HealthInfo:
         growth=jnp.asarray(1.0, rdt),
         iters=jnp.asarray(0, jnp.int32),
         converged=jnp.asarray(True),
+        abft_detected=jnp.asarray(0, jnp.int32),
+        abft_corrected=jnp.asarray(0, jnp.int32),
+        abft_site=jnp.asarray(-1, jnp.int32),
     )
 
 
@@ -128,6 +148,10 @@ def merge(*hs: HealthInfo) -> HealthInfo:
                                h.growth.astype(out.growth.dtype)),
             iters=out.iters + h.iters,
             converged=out.converged & h.converged,
+            abft_detected=out.abft_detected + h.abft_detected,
+            abft_corrected=out.abft_corrected + h.abft_corrected,
+            abft_site=jnp.where(out.abft_site >= 0, out.abft_site,
+                                h.abft_site),
         )
     return out
 

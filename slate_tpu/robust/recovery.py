@@ -42,7 +42,7 @@ from __future__ import annotations
 from ..exceptions import (SlateNotConvergedError,
                           SlateNotPositiveDefiniteError, SlateSingularError)
 from ..options import (ErrorPolicy, MethodEig, MethodGels, MethodLU,
-                       MethodSvd, Option, Options, get_option,
+                       MethodSvd, Option, Options, get_option, resolve_abft,
                        resolve_speculate, select_gels_method,
                        select_lu_method)
 from . import health as _h
@@ -145,26 +145,39 @@ def gesv_with_recovery(A, B, opts: Options | None = None):
     attempt is the certified RBT NoPiv fast path and the pivoted chain
     only runs when the certificate fails — eagerly, as always.
 
+    With ``Option.Abft`` the ladder grows a rung BELOW method
+    escalation: the drivers' in-place checksum repair handles a single
+    struck tile silently, and an UNREPAIRED detection (a multi-tile
+    strike reads ``abft_detected > abft_corrected``, which fails
+    ``health.acceptable``) retries the SAME method once — a transient
+    strike will not repeat — before the pivoted chain engages.
+
     Return shape matches gesv's ErrorPolicy contract: ``(F, X)`` under
     Raise/Nan, ``(F, X, HealthInfo)`` under Info."""
     method = select_lu_method(opts)
     speculate = resolve_speculate(opts)
+    abft = resolve_abft(opts)  # the one Option.Abft read (like Speculate)
     chain = _LU_CHAIN[method]
     if speculate:
         # the RBT attempt IS the NoPiv rung — escalation goes pivoted
         fb_methods = tuple(m for m in chain if m is not MethodLU.NoPiv)
         first = _rbt_attempt(A, B, opts)
+        same = lambda: _rbt_attempt(A, B, opts)            # noqa: E731
     else:
         fb_methods = chain[1:]
         first = _lu_attempt(A, B, opts, chain[0])
+        same = lambda: _lu_attempt(A, B, opts, chain[0])   # noqa: E731
     if not get_option(opts, Option.UseFallbackSolver):
         fb_methods = ()
+    retry_same = [same] if (abft and fb_methods) else []
     # bounded_retry demotes `converged` on growth beyond the limit: the raw
     # drivers keep growth out of .ok, the recovering solver does not.
     (F, X), h, _ = bounded_retry(
         first,
-        [lambda m=m: _lu_attempt(A, B, opts, m) for m in fb_methods],
-        dtype=A.dtype, max_retries=max(len(fb_methods), 1))
+        retry_same + [lambda m=m: _lu_attempt(A, B, opts, m)
+                      for m in fb_methods],
+        dtype=A.dtype,
+        max_retries=max(len(fb_methods) + len(retry_same), 1))
     return _finalize_solve("gesv", F, X, h, opts, _singular_exc("gesv"))
 
 
@@ -199,6 +212,11 @@ def posv_with_recovery(A, B, opts: Options | None = None):
     posv is already speculation-shaped — Cholesky (the cheapest factor)
     first, certified by its own pivots — so Option.Speculate changes
     nothing here; it reorders hesv (see hesv_with_recovery).
+    With ``Option.Abft`` an unrepaired checksum detection retries the
+    SAME Cholesky attempt once before the indefinite fallbacks — the
+    localized-repair-then-retry rung below full escalation (see
+    gesv_with_recovery).
+
     The first returned element is the factor object of whichever method
     succeeded (TriangularMatrix / HEFactors / LUFactors)."""
     first = _chol_attempt(A, B, opts)
@@ -206,7 +224,10 @@ def posv_with_recovery(A, B, opts: Options | None = None):
     if get_option(opts, Option.UseFallbackSolver):
         fallbacks = [lambda: _hesv_attempt(A, B, opts),
                      lambda: _gesv_attempt(A, B, opts)]
-    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype)
+        if resolve_abft(opts):  # the one Option.Abft read here
+            fallbacks.insert(0, lambda: _chol_attempt(A, B, opts))
+    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype,
+                                 max_retries=max(len(fallbacks), 2))
     return _finalize_solve(
         "posv", F, X, h, opts,
         lambda hh: SlateNotPositiveDefiniteError(

@@ -1,0 +1,350 @@
+"""ABFT checksum layer tests (robust/abft.py + Option.Abft wiring).
+
+Coverage map:
+
+- primitives: sum_check / tile_sum_check / left_product_check detect,
+  locate, and correct a single strike, and REFUSE multi-element strikes;
+- fault targeting: FaultPlan.tile confines a strike to one tile and an
+  out-of-range tile is a miss;
+- drivers: gesv/posv with a single injected bitflip locate the struck
+  tile exactly, repair in place, and report ``abft_corrected == 1`` with
+  ``h.ok`` — eager, jit, and mesh;
+- double strikes are detected but NOT mis-corrected (``detected >
+  corrected``, ``~h.ok``), and with Option.UseFallbackSolver the
+  recovery ladder's retry-same-method rung (below method escalation)
+  saves a transient double strike;
+- gemm/trsm: checksum verification is SILENT repair — a struck SUMMA
+  accumulator tile comes back clean with no API change;
+- transient plans are consumed at RUN time, once per activation — a
+  retrace at a second shape neither eats nor re-fires the strike.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.storage import TileStorage
+from slate_tpu.options import Option
+from slate_tpu.robust import abft, faults
+
+INFO = {Option.ErrorPolicy: "info", Option.Abft: "on"}
+
+
+def _site(h):
+    return int(h.abft_site) >> 16, int(h.abft_site) & 0xFFFF
+
+
+def _counts(h):
+    return int(h.abft_detected), int(h.abft_corrected)
+
+
+# ------------------------------------------------------------ primitives
+
+def test_sum_check_clean_and_single_strike(rng):
+    a = rng.standard_normal((12, 8))
+    x, ev = abft.sum_check(jnp.asarray(a), jnp.sum(a, axis=1),
+                           jnp.sum(a, axis=0))
+    assert int(ev.detected) == 0 and int(ev.site) == -1
+    for payload in (np.nan, np.inf, 2.0**80):
+        bad = a.copy()
+        bad[5, 3] = payload
+        x, ev = abft.sum_check(jnp.asarray(bad), jnp.sum(a, axis=1),
+                               jnp.sum(a, axis=0), nb=4)
+        assert int(ev.detected) == 1 and int(ev.corrected) == 1
+        assert int(ev.site) == abft.site_code(1, 0)  # element (5,3)//4
+        np.testing.assert_allclose(np.asarray(x), a, atol=1e-10)
+
+
+def test_sum_check_refuses_double_strike(rng):
+    a = rng.standard_normal((12, 8))
+    bad = a.copy()
+    bad[2, 1] = np.nan
+    bad[7, 5] = np.nan
+    x, ev = abft.sum_check(jnp.asarray(bad), jnp.sum(a, axis=1),
+                           jnp.sum(a, axis=0))
+    assert int(ev.detected) == 1 and int(ev.corrected) == 0
+    # refused: the data is left as-is, never silently mangled
+    assert np.isnan(np.asarray(x)[2, 1]) and np.isnan(np.asarray(x)[7, 5])
+
+
+def test_tile_sum_check_locates_struck_tile(rng):
+    a = rng.standard_normal((3, 2, 4, 4))
+    exp_r, exp_c = jnp.sum(a, axis=3), jnp.sum(a, axis=2)
+    bad = a.copy()
+    bad[2, 1, 0, 3] = 2.0**90
+    t4, ev, ti, tj = abft.tile_sum_check(jnp.asarray(bad), exp_r, exp_c)
+    assert (int(ti), int(tj)) == (2, 1)
+    assert int(ev.detected) == 1 and int(ev.corrected) == 1
+    np.testing.assert_allclose(np.asarray(t4), a, atol=1e-10)
+
+
+@pytest.mark.parametrize("payload", [np.nan, np.inf, 2.0**80])
+def test_left_product_check_payloads(rng, payload):
+    m, ncol = 8, 6
+    lmat = np.tril(rng.standard_normal((m, m))) + m * np.eye(m)
+    x = rng.standard_normal((m, ncol))
+    r = lmat @ x
+    bad = x.copy()
+    bad[4, 2] = payload
+    x2, det, cor, i0, j0 = abft.left_product_check(
+        jnp.asarray(lmat), jnp.asarray(bad),
+        jnp.sum(r, axis=1), jnp.sum(r, axis=0), unit=False)
+    assert bool(det) and bool(cor)
+    assert (int(i0), int(j0)) == (4, 2)
+    np.testing.assert_allclose(np.asarray(x2), x, atol=1e-9)
+
+
+def test_fault_tile_targeting_and_miss():
+    plan = faults.FaultPlan("input", kind="nan", tile=(1, 2), nb=4)
+    y = np.asarray(faults.corrupt(jnp.zeros((12, 16)), plan))
+    rows, cols = np.nonzero(np.isnan(y))
+    assert len(rows) == 1
+    assert 4 <= rows[0] < 8 and 8 <= cols[0] < 12
+    y4 = np.asarray(faults.corrupt(
+        jnp.zeros((2, 3, 4, 4)), faults.FaultPlan("input", kind="inf",
+                                                  tile=(0, 1))))
+    assert np.isinf(y4[0, 1]).sum() == 1 and np.isinf(y4).sum() == 1
+    miss = faults.FaultPlan("input", kind="nan", tile=(9, 0), nb=4)
+    assert np.isfinite(np.asarray(faults.corrupt(jnp.zeros((12, 16)),
+                                                 miss))).all()
+
+
+# ------------------------------------------------- dense gesv/posv paths
+
+def _dense_problem(rng, n=48, nb=16):
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    return a, b
+
+
+def test_gesv_abft_clean_zero_counters(rng):
+    a, b = _dense_problem(rng)
+    F, X, h = st.gesv(st.Matrix.from_numpy(a, 16, 16),
+                      st.Matrix.from_numpy(b, 16, 16), INFO)
+    assert _counts(h) == (0, 0) and int(h.abft_site) == -1
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               atol=1e-9)
+
+
+@pytest.mark.parametrize("mode", ["eager", "jit"])
+def test_gesv_single_bitflip_located_and_corrected(rng, mode):
+    n, nb = 48, 16
+    a, b = _dense_problem(rng, n, nb)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=5,
+                            tile=(n // nb - 1, 0), nb=nb)
+
+    def run(a, b):
+        F, X, h = st.gesv(st.Matrix(TileStorage.from_dense(a, nb, nb)),
+                          st.Matrix(TileStorage.from_dense(b, nb, nb)),
+                          INFO)
+        return X.to_dense(), h
+
+    with faults.inject(plan):
+        x, h = (jax.jit(run) if mode == "jit" else run)(
+            jnp.asarray(a), jnp.asarray(b))
+    assert _counts(h) == (1, 1)
+    assert _site(h) == (2, 0)              # the injected panel tile
+    assert bool(h.ok)                      # no escalation was needed
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                               atol=1e-9)
+
+
+@pytest.mark.parametrize("kind", ["nan", "inf", "bitflip"])
+def test_posv_transient_strike_corrected(rng, kind):
+    n, nb = 48, 16
+    a, b = _dense_problem(rng, n, nb)
+    hpd = a @ a.T / n + n * np.eye(n)
+    plan = faults.FaultPlan("post_panel", kind=kind, seed=7, transient=True)
+    with faults.inject(plan):
+        L, X, h = st.posv(st.HermitianMatrix.from_numpy(hpd, nb),
+                          st.Matrix.from_numpy(b, nb, nb), INFO)
+    assert _counts(h) == (1, 1)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(hpd, b),
+                               atol=1e-8)
+
+
+@pytest.mark.parametrize("mode", ["eager", "jit"])
+@pytest.mark.parametrize("kind", ["nan", "inf", "bitflip"])
+def test_gesv_double_strike_detected_not_corrected(rng, kind, mode):
+    n, nb = 48, 16
+    a, b = _dense_problem(rng, n, nb)
+    plan = faults.FaultPlan("post_panel", kind=kind, seed=5, count=2,
+                            tile=(n // nb - 1, 0), nb=nb)
+
+    def run(a, b):
+        F, X, h = st.gesv(st.Matrix(TileStorage.from_dense(a, nb, nb)),
+                          st.Matrix(TileStorage.from_dense(b, nb, nb)),
+                          INFO)
+        return X.to_dense(), h
+
+    with faults.inject(plan):
+        _, h = (jax.jit(run) if mode == "jit" else run)(
+            jnp.asarray(a), jnp.asarray(b))
+    det, cor = _counts(h)
+    assert det >= 1 and cor < det          # refused, never mis-corrected
+    assert not bool(h.ok)                  # surfaces as a health failure
+    if kind == "bitflip":
+        assert (det, cor) == (1, 0)
+
+
+def test_gesv_transient_double_strike_saved_by_retry_rung(rng):
+    """The new ladder rung: localized repair failed (two struck elements),
+    so recovery retries the SAME method once — the transient strike is
+    spent, the retry is clean — BELOW any method escalation."""
+    n, nb = 48, 16
+    a, b = _dense_problem(rng, n, nb)
+    A = st.Matrix.from_numpy(a, nb, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=5, count=2,
+                            transient=True, tile=(n // nb - 1, 0), nb=nb)
+    # with the ladder disabled the double strike stays a failure
+    with faults.inject(plan):
+        _, _, h0 = st.gesv(A, B, {**INFO, Option.UseFallbackSolver: False})
+    assert not bool(h0.ok)
+    with faults.inject(plan):
+        F, X, h = st.gesv(A, B, {**INFO, Option.UseFallbackSolver: True})
+    assert bool(h.ok)
+    assert _counts(h) == (0, 0)            # the clean retry's health
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               atol=1e-9)
+
+
+def test_posv_transient_double_strike_retries_cholesky(rng):
+    """posv's retry rung keeps the CHOLESKY factor (no hesv/gesv
+    escalation): the returned factor object stays triangular."""
+    n, nb = 48, 16
+    a, b = _dense_problem(rng, n, nb)
+    hpd = a @ a.T / n + n * np.eye(n)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=3, count=2,
+                            transient=True)
+    with faults.inject(plan):
+        F, X, h = st.posv(st.HermitianMatrix.from_numpy(hpd, nb),
+                          st.Matrix.from_numpy(b, nb, nb),
+                          {**INFO, Option.UseFallbackSolver: True})
+    assert bool(h.ok)
+    assert isinstance(F, st.TriangularMatrix)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(hpd, b),
+                               atol=1e-8)
+
+
+def test_transient_strike_survives_retrace(rng):
+    """Satellite regression: transient plans are consumed when the
+    computation RUNS, not when it is traced.  Tracing the same jitted
+    driver at a second shape under one activation must not re-fire (or
+    have pre-eaten) the single strike."""
+    nb = 8
+    opts = INFO
+
+    @jax.jit
+    def solve(a, b):
+        F, X, h = st.gesv(st.Matrix(TileStorage.from_dense(a, nb, nb)),
+                          st.Matrix(TileStorage.from_dense(b, nb, nb)),
+                          opts)
+        return X.to_dense(), h.abft_detected, h.abft_corrected
+
+    def mk(n):
+        a = rng.standard_normal((n, n)) + n * np.eye(n)
+        b = rng.standard_normal((n, 2))
+        return a, b
+
+    a1, b1 = mk(32)
+    a2, b2 = mk(40)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=9,
+                            transient=True)
+    with faults.inject(plan):
+        x1, d1, c1 = solve(jnp.asarray(a1), jnp.asarray(b1))
+        x2, d2, c2 = solve(jnp.asarray(a2), jnp.asarray(b2))  # retrace
+    assert (int(d1), int(c1)) == (1, 1)    # the one strike, repaired
+    assert (int(d2), int(c2)) == (0, 0)    # spent — no second strike
+    np.testing.assert_allclose(np.asarray(x1), np.linalg.solve(a1, b1),
+                               atol=1e-9)
+    np.testing.assert_allclose(np.asarray(x2), np.linalg.solve(a2, b2),
+                               atol=1e-9)
+
+
+# ------------------------------------------------------------ mesh paths
+
+def _mesh_grid(p=2, q=2):
+    return st.Grid(p, q, devices=jax.devices()[: p * q])
+
+
+def test_mesh_gesv_abft_clean_and_panel_strike(rng):
+    n, nb = 24, 4
+    g = _mesh_grid()
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    _, X, h = st.gesv(A, B, INFO)
+    assert _counts(h) == (0, 0) and bool(h.ok)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=11,
+                            tile=(n // nb - 1, 0), nb=nb)
+    with faults.inject(plan):
+        _, X, h = st.gesv(A, B, INFO)
+    assert _counts(h) == (1, 1)
+    assert _site(h) == (n // nb - 1, 0)
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(a, b),
+                               atol=1e-8)
+
+
+def test_mesh_posv_abft_collective_strike(rng):
+    n, nb = 24, 4
+    g = _mesh_grid()
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    hpd = a @ a.T / n + n * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    Ah = st.HermitianMatrix.from_numpy(hpd, nb, grid=g)
+    Bm = st.Matrix.from_numpy(b, nb, nb, g)
+    _, X, h = st.posv(Ah, Bm, INFO)
+    assert _counts(h) == (0, 0) and bool(h.ok)
+    plan = faults.FaultPlan("post_collective", kind="bitflip", seed=3,
+                            tile=(1, 0))
+    with faults.inject(plan):
+        _, X, h = st.posv(Ah, Bm, INFO)
+    assert _counts(h) == (1, 1)
+    assert _site(h) == (1, 0)              # the struck broadcast tile
+    assert bool(h.ok)
+    np.testing.assert_allclose(X.to_numpy(), np.linalg.solve(hpd, b),
+                               atol=1e-8)
+
+
+# --------------------------------------------- gemm/trsm (silent repair)
+
+@pytest.mark.parametrize("kind", ["nan", "inf", "bitflip"])
+def test_mesh_gemm_summa_silent_repair(rng, kind):
+    g = _mesh_grid()
+    a = rng.standard_normal((24, 20))
+    b = rng.standard_normal((20, 28))
+    A = st.Matrix.from_numpy(a, 4, 4, g)
+    B = st.Matrix.from_numpy(b, 4, 4, g)
+    plan = faults.FaultPlan("post_collective", kind=kind, seed=3,
+                            tile=(1, 2))
+    with faults.inject(plan):
+        C = st.gemm(1.0, A, B, opts={Option.Abft: "on"})
+        Cr = st.gemm(1.0, A, B)            # unprotected control
+    assert np.abs(C.to_numpy() - a @ b).max() < 1e-10
+    assert not np.abs(Cr.to_numpy() - a @ b).max() < 1e-10
+
+
+def test_gemm_trsm_abft_clean_no_false_positive(rng):
+    a = rng.standard_normal((24, 20))
+    b = rng.standard_normal((20, 28))
+    C = st.gemm(1.0, st.Matrix.from_numpy(a, 4),
+                st.Matrix.from_numpy(b, 4), opts={Option.Abft: "on"})
+    assert np.abs(C.to_numpy() - a @ b).max() < 1e-10
+    n, nrhs, nb = 24, 5, 4
+    L = np.tril(rng.standard_normal((n, n))) + n * np.eye(n)
+    rhs = rng.standard_normal((n, nrhs))
+    Lm = st.TriangularMatrix.from_numpy(L, nb)
+    Bm = st.Matrix.from_numpy(rhs, nb, nb)
+    X = st.trsm("l", 1.0, Lm, Bm, opts={Option.Abft: "on"})
+    assert np.abs(L @ X.to_numpy() - rhs).max() < 1e-10
+    rhs2 = rng.standard_normal((nrhs, n))
+    X2 = st.trsm("r", 1.0, Lm.T, st.Matrix.from_numpy(rhs2, nb, nb),
+                 opts={Option.Abft: "on"})
+    assert np.abs(X2.to_numpy() @ L.T - rhs2).max() < 1e-10

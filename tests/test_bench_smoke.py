@@ -39,6 +39,8 @@ TOY = [
     ("bench_posv", dict(n=64, nb=32, nrhs=4, iters=1)),
     ("bench_gesv", dict(n=64, nb=32, nrhs=4, iters=1)),
     ("bench_gesv_rbt", dict(n=64, nb=32, nrhs=4, iters=1)),
+    ("bench_gesv_abft", dict(n=64, nb=32, nrhs=4, iters=1)),
+    ("bench_posv_abft", dict(n=64, nb=32, nrhs=4, iters=1)),
     ("bench_geqrf", dict(m=96, n=32, nb=32, iters=1)),
     ("bench_gels", dict(m=96, n=32, nb=32, nrhs=4, iters=1)),
     ("bench_heev", dict(n=64, nb=32, iters=1)),
@@ -55,6 +57,9 @@ def test_metric_emits_json(bench, capsys, name, kwargs):
     assert line["unit"] == "GFLOP/s"
     assert isinstance(line["value"], (int, float)) and line["value"] > 0
     assert isinstance(line["vs_baseline"], (int, float))
+    if "abft" in name:
+        assert isinstance(line["abft_overhead_pct"], (int, float))
+        assert line["plain_gflops"] > 0
 
 
 def test_step_lists_cover_every_metric(bench):
@@ -64,6 +69,8 @@ def test_step_lists_cover_every_metric(bench):
     for steps in (bench.QUICK_STEPS, bench.FULL_STEPS):
         names = [fn.__name__ for fn, _ in steps]
         assert "bench_gesv_rbt" in names
+        assert "bench_gesv_abft" in names
+        assert "bench_posv_abft" in names
         for fn, kwargs in steps:
             sig = inspect.signature(fn)
             assert set(kwargs) == set(sig.parameters)

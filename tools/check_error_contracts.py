@@ -29,6 +29,19 @@ Plus the speculation-seam contract (Option.Speculate, docs/ROBUSTNESS.md):
 6. no driver module reads the raw ``Option.Speculate`` knob — drivers
    consume the resolved boolean, the enum never leaks past the boundary.
 
+Plus the ABFT-seam contract (Option.Abft, docs/ROBUSTNESS.md):
+
+7. ``robust/abft.py`` stays pure mechanism — no options import, no
+   ``raise`` statements: detection/correction is data (AbftCounts), the
+   driver boundary folds it into HealthInfo and resolves policy;
+8. every ABFT boundary (lu._getrf, cholesky.potrf, blas3.gemm/trsm,
+   recovery's gesv/posv_with_recovery) calls ``resolve_abft`` EXACTLY
+   once — resolved at the boundary like ErrorPolicy and Speculate;
+9. every ``maybe_corrupt`` call site names its fault site as a string
+   literal that exists in ``faults.SITES`` — injectable sites are a
+   closed, greppable vocabulary;
+10. no driver module reads the raw ``Option.Abft`` knob.
+
 Runnable as a main (exit 1 + report on violation) and as pytest via
 tests/test_error_contracts.py.
 """
@@ -195,8 +208,120 @@ def _check_speculation() -> list[str]:
     return problems
 
 
+ABFT_MODULE = REPO / "slate_tpu" / "robust" / "abft.py"
+FAULTS_MODULE = REPO / "slate_tpu" / "robust" / "faults.py"
+ABFT_BOUNDARIES = {
+    DRIVERS / "lu.py": ("_getrf",),
+    DRIVERS / "cholesky.py": ("potrf",),
+    DRIVERS / "blas3.py": ("gemm", "trsm"),
+    REPO / "slate_tpu" / "robust" / "recovery.py":
+        ("gesv_with_recovery", "posv_with_recovery"),
+}
+
+
+def _fault_sites() -> set[str]:
+    """The SITES vocabulary, read from faults.py's AST (no import)."""
+    tree = ast.parse(FAULTS_MODULE.read_text(), filename=str(FAULTS_MODULE))
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            targets = [node.target.id]
+        if "SITES" in targets and node.value is not None:
+            return {c.value for c in ast.walk(node.value)
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)}
+    return set()
+
+
+def _check_abft() -> list[str]:
+    problems = []
+    # 7. abft.py: pure mechanism — no options import, no raises
+    if not ABFT_MODULE.exists():
+        problems.append("robust/abft.py: missing (the checksum mechanism "
+                        "module the ABFT layer builds on)")
+        return problems
+    tree = ast.parse(ABFT_MODULE.read_text(), filename=str(ABFT_MODULE))
+    for node in ast.walk(tree):
+        mods = []
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mods = node.module.split(".")
+        elif isinstance(node, ast.Import):
+            mods = [s for a in node.names for s in a.name.split(".")]
+        if "options" in mods:
+            problems.append(
+                f"robust/abft.py:{node.lineno}: imports the options "
+                f"layer — checksum verification must stay policy-free "
+                f"(the seam is the driver boundary's resolve_abft)")
+        if isinstance(node, ast.Raise):
+            problems.append(
+                f"robust/abft.py:{node.lineno}: raises — detection is "
+                f"DATA (AbftCounts folded into HealthInfo); policy "
+                f"resolution lives at the driver boundary")
+    # 8. ABFT boundaries resolve the knob exactly once
+    for path, fns in ABFT_BOUNDARIES.items():
+        rel = path.relative_to(REPO)
+        if not path.exists():
+            problems.append(f"{rel}: missing ABFT boundary module")
+            continue
+        btree = ast.parse(path.read_text(), filename=str(path))
+        defs = {n.name: n for n in btree.body
+                if isinstance(n, ast.FunctionDef)}
+        for fname in fns:
+            fn = defs.get(fname)
+            if fn is None:
+                problems.append(f"{rel}: ABFT boundary `{fname}` "
+                                f"not found")
+                continue
+            n_res = _count_calls(fn, {"resolve_abft"})
+            if n_res != 1:
+                problems.append(
+                    f"{rel}:{fn.lineno}: `{fname}` calls resolve_abft "
+                    f"{n_res}x — the knob must be resolved EXACTLY once "
+                    f"at the boundary")
+    # 9. every maybe_corrupt call names a site literal from faults.SITES
+    sites = _fault_sites()
+    if not sites:
+        problems.append("robust/faults.py: SITES vocabulary not found")
+    for path in sorted((REPO / "slate_tpu").rglob("*.py")):
+        ptree = ast.parse(path.read_text(), filename=str(path))
+        rel = path.relative_to(REPO)
+        for node in ast.walk(ptree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "maybe_corrupt" or path == FAULTS_MODULE:
+                continue
+            if not node.args or not (isinstance(node.args[0], ast.Constant)
+                                     and isinstance(node.args[0].value,
+                                                    str)):
+                problems.append(
+                    f"{rel}:{node.lineno}: maybe_corrupt site is not a "
+                    f"string literal — sites must be a closed, greppable "
+                    f"vocabulary")
+            elif sites and node.args[0].value not in sites:
+                problems.append(
+                    f"{rel}:{node.lineno}: maybe_corrupt site "
+                    f"{node.args[0].value!r} not in faults.SITES")
+    # 10. the raw knob never leaks into a driver module
+    for path in sorted(DRIVERS.glob("*.py")):
+        dtree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(dtree):
+            if isinstance(node, ast.Attribute) and node.attr == "Abft":
+                problems.append(
+                    f"drivers/{path.name}:{node.lineno}: reads "
+                    f"Option.Abft directly — drivers consume "
+                    f"resolve_abft's boolean, never the raw knob")
+    return problems
+
+
 def check() -> list[str]:
-    problems = _check_speculation()
+    problems = _check_speculation() + _check_abft()
     for name in CHECKED_MODULES:
         path = DRIVERS / name
         if not path.exists():
