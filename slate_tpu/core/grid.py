@@ -33,6 +33,13 @@ from ..options import GridOrder
 AXIS_P = "p"
 AXIS_Q = "q"
 
+# Canonical PartitionSpec for block-cyclic local tile storage
+# [mtl, ntl, nb, nb]: the two leading (tile-grid) dims are sharded over
+# the mesh axes, the within-tile dims are replicated.  Every shard_map
+# driver in parallel/ uses this spec; keeping it next to the axis names
+# means a mesh rename cannot strand a stale spec.
+TILE_SPEC = P(AXIS_P, AXIS_Q, None, None)
+
 
 class Grid:
     """A p*q process grid backed by a ``jax.sharding.Mesh``.

@@ -179,6 +179,7 @@ def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     cidx = gidx.reshape(nch, block_rows)
 
     def keep_best(blocks, idx):
+        # slate-lint: disable=TRC001 -- capability probe: reads only static shape/dtype/env, never tracer data
         if _lu_select_ok(blocks, nb):
             from .pallas_lu import lu_select_pallas
             take = jax.vmap(lu_select_pallas)(blocks)

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.herk import herk_panel_update
 from ..robust import abft as _abft
 from ..robust import faults
@@ -274,7 +274,7 @@ def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
     nb = data.shape[-1]
     n = n if n is not None else Nt * nb
     sb = sb if sb is not None else superblock(Nt)
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb,
                                abft),

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import shard_map_unchecked
 from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
@@ -212,7 +212,7 @@ def dist_ge2tb(data, Mt: int, Ntn: int, m: int, n: int, grid: Grid,
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(max(Ntn, 1))
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _ge2tb_local(a, Mt, Ntn, m, n, grid.p, grid.q, mtl, ntl,
                                sb),
@@ -275,7 +275,7 @@ def _unmbr_v_local(a_loc, z_loc, Tls, n: int, p: int, q: int, ntl: int,
 def dist_unmbr_ge2tb_u(a_data, Tqs, z_data, grid: Grid, m: int):
     """Apply the ge2tb U1 (QR chain) to mesh-distributed Z."""
     mtl = a_data.shape[0] // grid.p
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a, z, t: _unmbr_u_local(a, z, t, m, grid.p, grid.q, mtl),
         mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)
@@ -287,7 +287,7 @@ def dist_unmbr_ge2tb_v(a_data, Tls, z_data, grid: Grid, n: int):
     column space)."""
     ntl = a_data.shape[1] // grid.q
     mtl_z = z_data.shape[0] // grid.p
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a, z, t: _unmbr_v_local(a, z, t, n, grid.p, grid.q,
                                        ntl, mtl_z),

@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import shard_map_unchecked
 from ..internal.qr import householder_panel_blocked, unit_lower
 from .dist_chol import superblock
@@ -190,7 +190,7 @@ def dist_he2hb(data, Nt: int, grid: Grid, n: int | None = None,
     n = n if n is not None else Nt * nb
     K = Nt - 1
     sb = sb if sb is not None else superblock(max(K, 1))
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _he2hb_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb),
         mesh=grid.mesh, in_specs=(spec,), out_specs=(spec, P()))
@@ -253,7 +253,7 @@ def dist_unmtr_he2hb(a_data, Ts, z_data, Nt: int, grid: Grid,
     mtl = a_data.shape[0] // grid.p
     nb = a_data.shape[-1]
     n = n if n is not None else Nt * nb
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a, z, t: _unmtr_local(a, z, t, Nt, n, grid.p, grid.q, mtl),
         mesh=grid.mesh, in_specs=(spec, spec, P()), out_specs=spec)

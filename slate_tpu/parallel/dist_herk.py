@@ -25,10 +25,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_from_col
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import pvary, shard_map_unchecked
 
 
@@ -130,7 +129,7 @@ def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
             jnp.where(valid[:, None, None], acc, jnp.zeros_like(acc)))
         return cflat.reshape(mtl, ntl, nb, nb)
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     args = (a_data, c_data) + ((b_data,) if two_k else ())
     fn = shard_map_unchecked(local, mesh=grid.mesh,
                        in_specs=(spec,) * len(args), out_specs=spec)
@@ -227,7 +226,7 @@ def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
                 acc = lax.fori_loop(k0, k1, super_step, acc)
         return jnp.asarray(alpha, dt) * acc
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(local, mesh=grid.mesh, in_specs=(spec, spec),
                        out_specs=spec)
     return fn(a_data, b_data)
@@ -299,7 +298,7 @@ def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
                 acc = lax.fori_loop(k0, k1, super_step, acc)
         return jnp.asarray(alpha, dt) * acc
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(local, mesh=grid.mesh, in_specs=(spec, spec),
                        out_specs=spec)
     return fn(a_data, b_data)

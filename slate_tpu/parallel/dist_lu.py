@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
                               panel_lu_tournament)
 from ..robust import abft as _abft
@@ -305,6 +305,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 return a_loc, perm_g, loc
 
             if S > 0 and T > 0:
+                # slate-lint: disable=COL003 -- k is the replicated fori_loop index and Nt is static: every rank evaluates the same predicate, so the psum branch is taken mesh-uniformly
                 a_loc, perm_g, loc = lax.cond(k < Nt - 1, tail,
                                               lambda cr: cr,
                                               (a_loc, perm_g, loc))
@@ -362,7 +363,7 @@ def dist_permute_rows(b_data, perm, grid: Grid):
         mine = strip[strip_idx]                    # [mtl*mb, ntl, nbr]
         return mine.reshape(mtl, mb, ntl, nbr).transpose(0, 2, 1, 3)
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, P()),
                        out_specs=spec)
     return fn(b_data, perm_pad)
@@ -419,7 +420,7 @@ def dist_rbt_two_sided(data, u_levels, v_levels, grid: Grid, n: int):
         return cordered[:, :, gc].reshape(mtl, nb, ntl, nb).transpose(
             0, 2, 1, 3)
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, P(), P()),
                        out_specs=spec)
     return fn(data, u_levels, v_levels)
@@ -446,7 +447,7 @@ def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(Nt)
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
                                     method, ib, sb, tau, mpt, depth, abft),

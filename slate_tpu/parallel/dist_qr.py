@@ -36,7 +36,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_along
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import pvary, shard_map_unchecked
 from ..internal.qr import (build_t, householder_panel,
                            householder_panel_blocked, unit_lower)
@@ -202,7 +202,7 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
 def dist_geqrf_data(data, Kt, Mt, m, n, grid: Grid):
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _geqrf_local(a, Kt, Mt, m, n, grid.p, grid.q, mtl, ntl),
         mesh=grid.mesh, in_specs=(spec,),
@@ -263,7 +263,7 @@ def dist_unmqr_data(a_data, c_data, Tloc, Vtree, Ttree, Kt, Mt, m,
                     grid: Grid, conj_trans: bool):
     mtl = a_data.shape[0] // grid.p
     ntl_c = c_data.shape[1] // grid.q
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a, cd, tl, vt, tt: _unmqr_local(
             a, cd, tl, vt, tt, Kt, Mt, m, grid.p, grid.q, mtl, ntl_c,

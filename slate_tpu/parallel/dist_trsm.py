@@ -29,9 +29,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.trsm import apply_op_tile
 from ..robust import faults
 from ..types import Op, Uplo
@@ -283,7 +282,7 @@ def dist_trsm_right(a_data, b_data, alpha, *, Nt, grid: Grid, lower: bool,
     ntl_b = b_data.shape[1] // grid.q
     n = n if n is not None else Nt * a_data.shape[-1]
     sb = sb if sb is not None else superblock(Nt)
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = jax.shard_map(
         lambda a, b: _trsm_right_local(
             a, b, alpha, Nt=Nt, n=n, p=grid.p, q=grid.q, lower=lower,
@@ -303,7 +302,7 @@ def dist_trsm_left(a_data, b_data, alpha, *, Nt, grid: Grid, lower: bool,
     ntl_b = b_data.shape[1] // grid.q
     n = n if n is not None else Nt * a_data.shape[-1]
     sb = sb if sb is not None else superblock(Nt)
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = jax.shard_map(
         lambda a, b: _trsm_local(
             a, b, alpha, Nt=Nt, n=n, p=grid.p, q=grid.q, lower=lower,

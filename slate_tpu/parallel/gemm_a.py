@@ -25,9 +25,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 
 
 def dist_gemmA_data(a_data, b_data, c_data, alpha, beta, Kt: int,
@@ -68,7 +67,7 @@ def dist_gemmA_data(a_data, b_data, c_data, alpha, beta, Kt: int,
                                 tiled=False)     # [mtl, ntl, mb, nb]
         return jnp.asarray(alpha, dt) * mine + jnp.asarray(beta, dt) * c_loc
 
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     fn = jax.shard_map(local, mesh=grid.mesh, in_specs=(spec, spec, spec),
                        out_specs=spec)
     return fn(a_data, b_data, c_data)

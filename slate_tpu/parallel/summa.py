@@ -26,7 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_from_col, bcast_from_row
-from ..core.grid import AXIS_P, AXIS_Q, Grid
+from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.gemm import tile_outer_product
 from ..robust import abft as _abft
 from ..robust import faults
@@ -104,7 +104,7 @@ def summa_gemm_data(a_data, b_data, c_data, alpha, beta, Kt, grid: Grid,
     """shard_map wrapper over the cyclic storage arrays.  With ``abft``
     returns ``(data, detected, corrected, site)`` — the extra outputs
     are fully replicated scalars."""
-    spec = P(AXIS_P, AXIS_Q, None, None)
+    spec = TILE_SPEC
     out_specs = (spec, P(), P(), P()) if abft else spec
     fn = jax.shard_map(
         lambda a, b, c: summa_local(a, b, c, alpha, beta, Kt,

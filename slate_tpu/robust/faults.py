@@ -189,6 +189,7 @@ def corrupt(x, plan: FaultPlan):
         return x.at[ti].set(sub)
     if x.ndim == 2:
         if plan.nb <= 0:
+            # slate-lint: disable=TRC006 -- plan validation on static config (nb is a host int): raises at trace time, before any tracer exists
             raise ValueError("FaultPlan.tile on a 2D array requires nb > 0")
         r0, c0 = ti * plan.nb, tj * plan.nb
         if r0 >= x.shape[0] or c0 >= x.shape[1]:
@@ -196,6 +197,7 @@ def corrupt(x, plan: FaultPlan):
         sub = x[r0:r0 + plan.nb, c0:c0 + plan.nb]
         sub = _strike_flat(sub.reshape(-1), sub.size, plan).reshape(sub.shape)
         return x.at[r0:r0 + sub.shape[0], c0:c0 + sub.shape[1]].set(sub)
+    # slate-lint: disable=TRC006 -- dispatch on static ndim: unsupported ranks fail at trace time by design
     raise ValueError(f"FaultPlan.tile targeting needs a 2D/3D/4D array, "
                      f"got ndim={x.ndim}")
 

@@ -1,0 +1,731 @@
+"""slate-lint test suite (tools/slate_lint/).
+
+Every rule has at least one *bad* fixture that demonstrably fires and one
+*good* fixture that stays silent, plus: reachability/taint unit coverage,
+suppression + baseline + CLI mechanics, legacy seam-report text fidelity,
+and the tier-1 repo-wide clean run.
+
+Fixtures are synthesized mini-repos under tmp_path — never the live tree
+— so they are free to violate every contract on purpose.
+"""
+
+import json
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.slate_lint import cli, load_project, reachability  # noqa: E402
+from tools.slate_lint.model import REGISTRY, parse_suppressions  # noqa: E402
+from tools.slate_lint.rules import seams  # noqa: E402
+
+cli.load_rules()
+
+SEAM_IDS = {r for r in REGISTRY if r.startswith("SEAM")}
+
+# --------------------------------------------------------------------------
+# helpers
+
+
+def mini_repo(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def lint(root, select):
+    project = load_project(root)
+    return cli.run_rules(project, select=set(select))
+
+
+def rule_ids(findings):
+    return {f.rule for f in findings}
+
+
+GRID = """\
+    AXIS_P = "p"
+    AXIS_Q = "q"
+    """
+
+# --------------------------------------------------------------------------
+# trace-safety pack (TRC001-TRC006)
+
+
+def _jit_mod(body):
+    return ("import jax\nimport jax.numpy as jnp\n"
+            "from jax import lax\nimport numpy as np\n\n\n"
+            "@jax.jit\ndef entry(x):\n" + textwrap.indent(
+                textwrap.dedent(body), "    "))
+
+
+def test_trc001_fires_on_traced_branch(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        y = jnp.sum(x)
+        if y > 0:
+            return y
+        return -y
+        """)})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [10]
+
+
+def test_trc001_silent_on_static_branch(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        if x.ndim > 2:              # .ndim is static under tracing
+            return jnp.sum(x)
+        if x.shape[0] == 4:         # so is .shape
+            return x
+        if x is None:               # identity never concretizes
+            return x
+        return x
+        """)})
+    assert lint(root, {"TRC001"}) == []
+
+
+def test_trc002_fires_on_traced_loop(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        while jnp.sum(x) > 0:
+            x = x - 1
+        for v in jnp.abs(x):
+            x = x + v
+        return x
+        """)})
+    fs = lint(root, {"TRC002"})
+    assert [f.line for f in fs] == [9, 11]
+
+
+def test_trc002_silent_on_static_loop(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        for i in range(x.shape[0]):
+            x = x + i
+        while getattr(x, "ndim", 0) > 3:   # static-result builtin
+            x = jnp.sum(x, axis=0)
+        return x
+        """)})
+    assert lint(root, {"TRC002"}) == []
+
+
+def test_trc003_fires_on_traced_assert(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        assert jnp.all(x > 0)
+        return x
+        """)})
+    assert rule_ids(lint(root, {"TRC003"})) == {"TRC003"}
+
+
+def test_trc003_silent_on_static_assert(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        assert x.ndim == 2
+        return x
+        """)})
+    assert lint(root, {"TRC003"}) == []
+
+
+def test_trc004_fires_on_concretization(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        a = float(jnp.sum(x))
+        b = x.item()
+        return a + b
+        """)})
+    assert len(lint(root, {"TRC004"})) == 2
+
+
+def test_trc004_silent_on_static_concretization(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        n = int(x.shape[0])
+        return x * float(n)
+        """)})
+    assert lint(root, {"TRC004"}) == []
+
+
+def test_trc005_fires_on_numpy_on_traced(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        return np.linalg.norm(jnp.sum(x))
+        """)})
+    assert rule_ids(lint(root, {"TRC005"})) == {"TRC005"}
+
+
+def test_trc005_silent_on_numpy_on_static(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        idx = np.arange(x.shape[0])    # static shape math is fine
+        return x * jnp.asarray(idx)
+        """)})
+    assert lint(root, {"TRC005"}) == []
+
+
+def test_trc006_fires_on_raise_in_traced(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        raise ValueError("boom")
+        """)})
+    assert rule_ids(lint(root, {"TRC006"})) == {"TRC006"}
+
+
+def test_trc006_silent_outside_traced_set_and_at_boundaries(tmp_path):
+    root = mini_repo(tmp_path, {
+        # eager helper: never traced, free to raise
+        "slate_tpu/mod.py": "def helper(x):\n    raise ValueError(x)\n",
+        # registered eager boundary module: raises allowed
+        "slate_tpu/robust/health.py": (
+            "import jax\n\n\n@jax.jit\ndef finalize(x):\n"
+            "    raise ValueError(x)\n"),
+    })
+    assert lint(root, {"TRC006"}) == []
+
+
+def test_traced_set_follows_fori_loop_body(tmp_path):
+    """Transitive tracing: a fori_loop body referenced (not called) from a
+    jit entry is traced, and its closure inherits the entry's taint."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        def body(i, c):
+            if c > 0:           # c is the traced carry
+                return c
+            return c + 1
+        return lax.fori_loop(0, 3, body, jnp.sum(x))
+        """)})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [10]
+
+
+def test_shard_map_lambda_closure_args_stay_static(tmp_path):
+    """The repo's shard_map idiom: statics are closure-bound through a
+    lambda (``lambda a: body(a, Nt=Nt)``); only lambda params are traced."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def _local(a, *, Nt, method):
+            if Nt > 2:                   # static: closure-bound int
+                a = a * 2
+            if method == "fast":         # static: closure-bound str
+                a = a + 1
+            if jnp.sum(a) > 0:           # traced: fed from lambda param
+                a = -a
+            return a
+
+
+        def driver(a_data, Nt, method):
+            fn = jax.shard_map(lambda a: _local(a, Nt=Nt, method=method),
+                               mesh=None, in_specs=(), out_specs=())
+            return fn(a_data)
+        """})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [10]
+
+
+def test_defaulted_params_of_loop_bodies_stay_static(tmp_path):
+    """``def step(k, c, W0=W0)`` static-capture idiom: defaulted params of
+    non-entry nested defs are not tainted."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        W0 = 4
+
+        def step(k, c, W0=W0):
+            if W0 > 2:          # static capture
+                return c + k
+            return c
+        return lax.fori_loop(0, 3, step, jnp.sum(x))
+        """)})
+    assert lint(root, {"TRC001"}) == []
+
+
+# --------------------------------------------------------------------------
+# collective-discipline pack (COL001-COL004)
+
+
+COL_HEADER = """\
+    from jax import lax
+
+    from .core.grid import AXIS_P, AXIS_Q
+
+    """
+
+
+def test_col001_fires_on_unknown_axis(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x):
+        ax = mystery()
+        return lax.psum(x, ax)
+    """})
+    assert rule_ids(lint(root, {"COL001"})) == {"COL001"}
+
+
+def test_col001_silent_on_constants_and_wrapper_params(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x):
+        return lax.psum(lax.psum(x, AXIS_P), AXIS_Q)
+
+
+    def generic(x, axis):
+        # the comm/collectives.py pattern: axis is a wrapper parameter
+        return lax.psum(x, axis), lax.axis_index(axis)
+
+
+    def local_alias(x):
+        ax = AXIS_P
+        return lax.pmax(x, ax)
+
+
+    def tuple_axes(x):
+        return lax.psum(x, (AXIS_P, AXIS_Q))
+    """})
+    assert lint(root, {"COL001"}) == []
+
+
+def test_col002_fires_on_vocabulary_literal(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x):
+        return lax.psum(x, "p")
+    """})
+    assert rule_ids(lint(root, {"COL002"})) == {"COL002"}
+
+
+def test_col002_silent_on_constant(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x):
+        return lax.psum(x, AXIS_P)
+    """})
+    assert lint(root, {"COL002"}) == []
+
+
+def test_col003_fires_on_one_sided_collective(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x, pred):
+        return lax.cond(pred, lambda c: lax.psum(c, AXIS_P),
+                        lambda c: c, x)
+    """})
+    assert rule_ids(lint(root, {"COL003"})) == {"COL003"}
+
+
+def test_col003_fires_through_named_branch_functions(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def hot(c):
+        return lax.psum(c, AXIS_P)
+
+
+    def cold(c):
+        return c
+
+
+    def f(x, pred):
+        return lax.cond(pred, hot, cold, x)
+    """})
+    assert rule_ids(lint(root, {"COL003"})) == {"COL003"}
+
+
+def test_col003_silent_when_both_branches_collective(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/grid.py": GRID,
+        "slate_tpu/mod.py": COL_HEADER + """\
+
+    def f(x, pred):
+        return lax.cond(pred, lambda c: lax.psum(c, AXIS_P),
+                        lambda c: lax.pmax(c, AXIS_P), x)
+
+
+    def g(x, pred):
+        # collective-free cond: nothing to diverge on
+        return lax.cond(pred, lambda c: c + 1, lambda c: c - 1, x)
+    """})
+    assert lint(root, {"COL003"}) == []
+
+
+def test_col004_fires_outside_fault_seam(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        from jax.experimental import io_callback
+
+
+        def f(x):
+            return io_callback(print, None, x)
+        """})
+    assert rule_ids(lint(root, {"COL004"})) == {"COL004"}
+
+
+def test_col004_silent_inside_fault_seam(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/robust/faults.py": """\
+        from jax.experimental import io_callback
+
+
+        def consume(x):
+            return io_callback(print, None, x)
+        """})
+    assert lint(root, {"COL004"}) == []
+
+
+# --------------------------------------------------------------------------
+# seam pack (SEAM001-SEAM010): a clean skeleton, mutated per rule
+
+
+def _driver(fn):
+    return (f"from ..robust import health\n\n\n"
+            f"def {fn}(a, opts=None):\n    return health.finalize(a)\n")
+
+
+def seam_skeleton():
+    files = {
+        "slate_tpu/internal/rbt.py": "def butterfly(a):\n    return a\n",
+        "slate_tpu/robust/abft.py": (
+            "def tile_check(a):\n    return a, 0\n"),
+        "slate_tpu/robust/faults.py": (
+            'SITES = ("site_a", "site_b")\n\n\n'
+            "def maybe_corrupt(site, x):\n    return x\n"),
+        "slate_tpu/robust/recovery.py": """\
+            def gesv_with_recovery(a, opts=None):
+                spec = resolve_speculate(opts)
+                ab = resolve_abft(opts)
+                r = bounded_retry(a)
+                return finalize(r)
+
+
+            def gels_with_recovery(a, opts=None):
+                spec = resolve_speculate(opts)
+                r = bounded_retry(a)
+                return finalize(r)
+
+
+            def hesv_with_recovery(a, opts=None):
+                spec = resolve_speculate(opts)
+                r = bounded_retry(a)
+                return finalize(r)
+
+
+            def posv_with_recovery(a, opts=None):
+                ab = resolve_abft(opts)
+                r = bounded_retry(a)
+                return finalize(r)
+            """,
+        "slate_tpu/drivers/blas3.py": """\
+            def gemm(a, b):
+                ok = resolve_abft(None)
+                return a
+
+
+            def trsm(a, b):
+                ok = resolve_abft(None)
+                return a
+            """,
+        "slate_tpu/drivers/lu.py": (
+            "from ..robust import health\n\n\n"
+            "def _getrf(a):\n    ok = resolve_abft(None)\n    return a\n\n\n"
+            "def getrf(a, opts=None):\n    return health.finalize(a)\n"),
+        "slate_tpu/drivers/cholesky.py": (
+            "from ..robust import health\n\n\n"
+            "def potrf(a, opts=None):\n    ok = resolve_abft(None)\n"
+            "    return health.finalize(a)\n"),
+        "slate_tpu/drivers/mixed.py": (
+            "from ..robust import health\n\n\n"
+            "def gesv_mixed(a, opts=None):\n"
+            "    spec = resolve_speculate(opts)\n"
+            "    return health.finalize(a)\n"),
+    }
+    for name in ("band.py", "qr.py", "heev.py", "svd.py", "stedc.py",
+                 "hetrf.py", "inverse.py", "condest.py"):
+        files[f"slate_tpu/drivers/{name}"] = _driver(name[:-3])
+    return files
+
+
+def test_seam_skeleton_is_clean(tmp_path):
+    root = mini_repo(tmp_path, seam_skeleton())
+    assert lint(root, SEAM_IDS) == []
+
+
+def _mutated(tmp_path, rel, src):
+    files = seam_skeleton()
+    files[rel] = src
+    return mini_repo(tmp_path, files)
+
+
+def test_seam001_fires_on_driver_without_opts(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/qr.py",
+                    _driver("qr") + "\n\ndef geqrf(a):\n    return a\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM001"}
+    assert fs[0].legacy == (
+        f"qr.py:{fs[0].line}: public driver `geqrf` does not accept "
+        f"`opts` — Option.ErrorPolicy cannot reach it")
+
+
+def test_seam001_silent_on_exempt_names(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/qr.py",
+                    _driver("qr") + "\n\ndef lower(a):\n    return a\n")
+    assert lint(root, SEAM_IDS) == []
+
+
+def test_seam002_fires_without_robust_import(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/band.py",
+                    "def band(a, opts=None):\n    return a\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM002"}
+    assert "does not import the robust layer" in fs[0].legacy
+
+
+def test_seam003_fires_on_import_without_health_reference(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/band.py",
+                    "from ..robust import health\n\n\n"
+                    "def band(a, opts=None):\n    return a\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM003"}
+
+
+def test_seam004_fires_on_rbt_policy_import(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/internal/rbt.py",
+                    "from ..robust import recovery\n\n\n"
+                    "def butterfly(a):\n    return a\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM004"}
+    assert fs[0].legacy == (
+        "internal/rbt.py:1: imports the options/robust layer — the "
+        "butterfly mechanism must stay policy-free (the seam is "
+        "drivers/lu.py + robust/recovery.py)")
+
+
+def test_seam005_fires_on_double_resolve(tmp_path):
+    files = seam_skeleton()
+    src = textwrap.dedent(files["slate_tpu/robust/recovery.py"]).replace(
+        "def gesv_with_recovery(a, opts=None):\n"
+        "    spec = resolve_speculate(opts)\n",
+        "def gesv_with_recovery(a, opts=None):\n"
+        "    spec = resolve_speculate(opts)\n"
+        "    spec = resolve_speculate(opts)\n", 1)
+    root = _mutated(tmp_path, "slate_tpu/robust/recovery.py", src)
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM005"}
+    assert "resolve_speculate 2x" in fs[0].legacy
+
+
+def test_seam005_fires_on_missing_escalation(tmp_path):
+    files = seam_skeleton()
+    src = textwrap.dedent(files["slate_tpu/robust/recovery.py"]).replace(
+        "def hesv_with_recovery(a, opts=None):\n"
+        "    spec = resolve_speculate(opts)\n"
+        "    r = bounded_retry(a)\n",
+        "def hesv_with_recovery(a, opts=None):\n"
+        "    spec = resolve_speculate(opts)\n"
+        "    r = a\n", 1)
+    root = _mutated(tmp_path, "slate_tpu/robust/recovery.py", src)
+    fs = lint(root, SEAM_IDS)
+    assert "never routes through bounded_retry" in fs[0].legacy
+
+
+def test_seam006_fires_on_speculate_knob_in_driver(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/svd.py",
+                    _driver("svd") +
+                    "\n\ndef peek(a, opts=None):\n"
+                    "    return Option.Speculate\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM006"}
+    assert fs[0].legacy.startswith("drivers/svd.py:")
+
+
+def test_seam007_fires_on_abft_raise(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/robust/abft.py",
+                    "def tile_check(a):\n"
+                    "    raise ValueError('detected')\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM007"}
+    assert "detection is DATA" in fs[0].legacy
+
+
+def test_seam008_fires_on_double_resolve_abft(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/cholesky.py",
+                    "from ..robust import health\n\n\n"
+                    "def potrf(a, opts=None):\n"
+                    "    ok = resolve_abft(None)\n"
+                    "    ok = resolve_abft(None)\n"
+                    "    return health.finalize(a)\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM008"}
+    assert "resolve_abft 2x" in fs[0].legacy
+
+
+def test_seam009_fires_on_unknown_or_computed_site(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/band.py",
+                    _driver("band") +
+                    "\n\ndef inject(a, s, opts=None):\n"
+                    "    a = maybe_corrupt('not_a_site', a)\n"
+                    "    return maybe_corrupt(s, a)\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM009"}
+    msgs = " ".join(f.legacy for f in fs)
+    assert "'not_a_site' not in faults.SITES" in msgs
+    assert "not a string literal" in msgs
+
+
+def test_seam009_silent_on_vocabulary_site(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/band.py",
+                    _driver("band") +
+                    "\n\ndef inject(a, opts=None):\n"
+                    "    return maybe_corrupt('site_a', a)\n")
+    assert lint(root, SEAM_IDS) == []
+
+
+def test_seam010_fires_on_abft_knob_in_driver(tmp_path):
+    root = _mutated(tmp_path, "slate_tpu/drivers/hetrf.py",
+                    _driver("hetrf") +
+                    "\n\ndef peek(a, opts=None):\n"
+                    "    return Option.Abft\n")
+    fs = lint(root, SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM010"}
+
+
+def test_legacy_report_order_matches_old_checker(tmp_path):
+    """The shim's report groups speculation -> abft -> per-module, exactly
+    the pre-migration ordering (tools/check_error_contracts.py)."""
+    files = seam_skeleton()
+    files["slate_tpu/internal/rbt.py"] = (
+        "from ..robust import recovery\n\ndef butterfly(a):\n    return a\n")
+    files["slate_tpu/drivers/band.py"] = (
+        "def band(a):\n    return a\n")
+    root = mini_repo(tmp_path, files)
+    report = seams.legacy_report(load_project(root))
+    assert len(report) == 3
+    assert report[0].startswith("internal/rbt.py:1:")         # point 4
+    assert report[1].startswith("band.py: does not import")   # point 2
+    assert report[2].startswith("band.py:1: public driver")   # point 1
+
+
+# --------------------------------------------------------------------------
+# suppressions, baseline, CLI
+
+
+def test_inline_and_standalone_suppressions(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        y = jnp.sum(x)
+        if y > 0:  # slate-lint: disable=TRC001 -- demo reason
+            x = -x
+        # slate-lint: disable=TRC001 -- standalone form
+        if y > 1:
+            x = x + 1
+        if y > 2:
+            x = x * 2
+        return x
+        """)})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [15]   # only the unsuppressed branch
+
+
+def test_suppression_parsing_units():
+    sup = parse_suppressions([
+        (3, "# slate-lint: disable=TRC001,COL002 -- why", False),
+        (7, "# slate-lint: disable=all", True),
+    ])
+    assert sup[3] == {"TRC001", "COL002"}
+    assert sup[7] == {"all"} and sup[8] == {"all"}
+
+
+def test_cli_baseline_roundtrip(tmp_path, capsys):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        if jnp.sum(x) > 0:
+            return x
+        return -x
+        """)})
+    bl = tmp_path / "baseline.json"
+    args = ["--root", str(root), "--select", "TRC001",
+            "--baseline", str(bl)]
+    assert cli.main(args) == 1
+    assert cli.main(args + ["--update-baseline"]) == 0
+    assert json.loads(bl.read_text())          # non-empty fingerprints
+    assert cli.main(args) == 0                 # baselined -> clean
+    capsys.readouterr()
+
+
+def test_cli_json_format(tmp_path, capsys):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": _jit_mod("""\
+        if jnp.sum(x) > 0:
+            return x
+        return -x
+        """)})
+    bl = tmp_path / "baseline.json"
+    assert cli.main(["--root", str(root), "--select", "TRC001",
+                     "--baseline", str(bl), "--format", "json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"][0]["rule"] == "TRC001"
+    assert out["baselined"] == 0
+
+
+def test_cli_rejects_unknown_rule(tmp_path, capsys):
+    assert cli.main(["--root", str(tmp_path), "--select", "NOPE9"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "TRC001" in out and "COL003" in out and "SEAM010" in out
+
+
+# --------------------------------------------------------------------------
+# engine units
+
+
+def test_reachability_entry_forms(tmp_path):
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+        from functools import partial
+
+
+        @jax.jit
+        def a(x):
+            return b(x)
+
+
+        def b(x):
+            return x
+
+
+        @partial(jax.jit, static_argnames=("n",))
+        def c(x, n):
+            return x
+
+
+        def never(x):
+            return x
+        """})
+    reach = reachability.compute(load_project(root))
+    t = {k.split("::")[1] for k in reach.traced}
+    assert t == {"a", "b", "c"}
+    assert reach.functions["slate_tpu/mod.py::c"].static_params == {"n"}
+
+
+def test_registry_has_required_rule_surface():
+    assert len(REGISTRY) >= 14
+    packs = {"TRC", "COL", "SEAM"}
+    assert {r[:3] if not r.startswith("SEAM") else "SEAM"
+            for r in REGISTRY} == packs
+
+
+# --------------------------------------------------------------------------
+# tier-1: the live repo is lint-clean with an empty baseline diff
+
+
+def test_repo_is_lint_clean(capsys):
+    assert cli.main(["--root", str(REPO)]) == 0
+    capsys.readouterr()
+
+
+def test_repo_baseline_is_empty():
+    assert json.loads(
+        (REPO / "tools/slate_lint/baseline.json").read_text()) == []

@@ -2,351 +2,48 @@
 """Static check: every public factor/solve driver honors the robustness
 contract (docs/ROBUSTNESS.md).
 
-Three assertions, enforced by AST inspection (no imports, no jax, runs
-anywhere):
+This is now a thin shim over the slate-lint seam rule pack
+(``tools/slate_lint/rules/seams.py``, rules SEAM001-SEAM010) — the ten
+assertions documented there were migrated from this file verbatim, and
+the pack preserves this checker's report text and ordering byte-for-byte
+(each Finding carries the ``legacy`` string).  Kept because:
 
-1. every public driver function in the checked modules accepts an ``opts``
-   parameter — Option.ErrorPolicy must be routable to every entry point;
-2. every checked module routes failures through the robust layer — it
-   imports from ``slate_tpu.robust`` (health / faults / recovery /
-   certify) at module level or inside a function body;
-3. every checked module actually RESOLVES a policy: it references the
-   health machinery (``finalize`` / ``finalize_flat`` / ``error_policy``
-   / ``HealthInfo``) somewhere in its body — an import alone is not a
-   contract.
+- tests/test_error_contracts.py and CI invoke it by this name;
+- ``python tools/check_error_contracts.py`` remains the quick
+  seam-contract-only entry point (the full analyzer is
+  ``python -m tools.slate_lint``).
 
-Plus the speculation-seam contract (Option.Speculate, docs/ROBUSTNESS.md):
-
-4. ``internal/rbt.py`` stays pure mechanism — it must not import the
-   options or robust layers (the policy seam lives in drivers/lu.py and
-   robust/recovery.py);
-5. every speculative boundary function (recovery.py's
-   gesv/gels/hesv_with_recovery, mixed.py's gesv_mixed) calls
-   ``resolve_speculate`` EXACTLY once — the knob is resolved at the
-   driver boundary like ErrorPolicy, never re-read downstream — and the
-   recovery boundaries route through ``bounded_retry`` and finalize the
-   (result, HealthInfo) pair exactly once;
-6. no driver module reads the raw ``Option.Speculate`` knob — drivers
-   consume the resolved boolean, the enum never leaks past the boundary.
-
-Plus the ABFT-seam contract (Option.Abft, docs/ROBUSTNESS.md):
-
-7. ``robust/abft.py`` stays pure mechanism — no options import, no
-   ``raise`` statements: detection/correction is data (AbftCounts), the
-   driver boundary folds it into HealthInfo and resolves policy;
-8. every ABFT boundary (lu._getrf, cholesky.potrf, blas3.gemm/trsm,
-   recovery's gesv/posv_with_recovery) calls ``resolve_abft`` EXACTLY
-   once — resolved at the boundary like ErrorPolicy and Speculate;
-9. every ``maybe_corrupt`` call site names its fault site as a string
-   literal that exists in ``faults.SITES`` — injectable sites are a
-   closed, greppable vocabulary;
-10. no driver module reads the raw ``Option.Abft`` knob.
-
-Runnable as a main (exit 1 + report on violation) and as pytest via
-tests/test_error_contracts.py.
+Exit codes are unchanged: 0 clean, 1 with a violation report.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-DRIVERS = REPO / "slate_tpu" / "drivers"
+if str(REPO) not in sys.path:  # the test imports this file top-level
+    sys.path.insert(0, str(REPO))
 
-# the factor/solve surface: modules whose failures are numerical
-CHECKED_MODULES = (
-    "lu.py", "cholesky.py", "band.py", "mixed.py", "qr.py",
-    # the certified spectral stack
-    "heev.py", "svd.py", "stedc.py", "hetrf.py", "inverse.py",
-    "condest.py",
+from tools.slate_lint.loader import load_project  # noqa: E402
+from tools.slate_lint.rules.seams import (  # noqa: E402,F401
+    # re-exported configuration (public knobs of the old checker)
+    ABFT_BOUNDARIES,
+    ABFT_MODULE,
+    CHECKED_MODULES,
+    EXEMPT,
+    FINALIZE_NAMES,
+    HEALTH_NAMES,
+    RBT_MODULE,
+    RECOVERY_BOUNDARIES,
+    SPECULATIVE_BOUNDARIES,
+    legacy_report,
 )
-
-# public callables that are not drivers (constructors, helpers) or whose
-# contract predates opts (factor-object methods)
-EXEMPT = {
-    "tree_flatten", "tree_unflatten", "lower", "upper",
-    # norm1est is an estimator primitive taking raw appliers, not a
-    # driver: its failure resolution (inf, never NaN) is value-level
-    "norm1est",
-    # *_info compute APIs always return (result, HealthInfo) — there is
-    # no policy to route, the caller resolves it
-    "stedc_info",
-}
-
-# names whose presence shows the module resolves ErrorPolicy through the
-# health layer rather than merely importing it
-HEALTH_NAMES = {"finalize", "finalize_flat", "error_policy", "HealthInfo",
-                "from_pivots", "from_result"}
-
-
-def _public_functions(tree: ast.Module):
-    for node in tree.body:
-        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
-            yield node
-
-
-def _accepts_opts(fn: ast.FunctionDef) -> bool:
-    names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
-    return "opts" in names or fn.args.kwarg is not None
-
-
-def _imports_robust(tree: ast.Module) -> bool:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module:
-            mod = node.module
-            if "robust" in mod.split("."):
-                return True
-            if mod.endswith("robust") or ".robust." in f".{mod}.":
-                return True
-        if isinstance(node, ast.Import):
-            if any("robust" in alias.name.split(".")
-                   for alias in node.names):
-                return True
-    return False
-
-
-def _references_health(tree: ast.Module) -> bool:
-    """True when the module calls into the health machinery — a Name or
-    Attribute access of one of HEALTH_NAMES anywhere in the body."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Attribute) and node.attr in HEALTH_NAMES:
-            return True
-        if isinstance(node, ast.Name) and node.id in HEALTH_NAMES:
-            return True
-    return False
-
-
-# speculation boundaries: file -> functions that must resolve the knob
-# exactly once (and, for the recovery ones, retry + finalize exactly once)
-SPECULATIVE_BOUNDARIES = {
-    REPO / "slate_tpu" / "robust" / "recovery.py":
-        ("gesv_with_recovery", "gels_with_recovery", "hesv_with_recovery"),
-    DRIVERS / "mixed.py": ("gesv_mixed",),
-}
-RECOVERY_BOUNDARIES = {"gesv_with_recovery", "gels_with_recovery",
-                       "hesv_with_recovery"}
-RBT_MODULE = REPO / "slate_tpu" / "internal" / "rbt.py"
-FINALIZE_NAMES = {"finalize", "_finalize_solve"}
-
-
-def _count_calls(fn: ast.FunctionDef, names: set[str]) -> int:
-    c = 0
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Call):
-            f = node.func
-            if isinstance(f, ast.Name) and f.id in names:
-                c += 1
-            elif isinstance(f, ast.Attribute) and f.attr in names:
-                c += 1
-    return c
-
-
-def _check_speculation() -> list[str]:
-    problems = []
-    # 4. rbt.py: pure mechanism, policy-free
-    if not RBT_MODULE.exists():
-        problems.append("internal/rbt.py: missing (the RBT mechanism "
-                        "module the speculative gesv path builds on)")
-    else:
-        tree = ast.parse(RBT_MODULE.read_text(), filename=str(RBT_MODULE))
-        for node in ast.walk(tree):
-            mods = []
-            if isinstance(node, ast.ImportFrom) and node.module:
-                mods = node.module.split(".")
-            elif isinstance(node, ast.Import):
-                mods = [s for a in node.names for s in a.name.split(".")]
-            if "options" in mods or "robust" in mods:
-                problems.append(
-                    f"internal/rbt.py:{node.lineno}: imports the "
-                    f"options/robust layer — the butterfly mechanism must "
-                    f"stay policy-free (the seam is drivers/lu.py + "
-                    f"robust/recovery.py)")
-    # 5. boundary functions resolve the knob exactly once
-    for path, fns in SPECULATIVE_BOUNDARIES.items():
-        rel = path.relative_to(REPO)
-        if not path.exists():
-            problems.append(f"{rel}: missing speculative boundary module")
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        defs = {n.name: n for n in tree.body
-                if isinstance(n, ast.FunctionDef)}
-        for fname in fns:
-            fn = defs.get(fname)
-            if fn is None:
-                problems.append(f"{rel}: speculative boundary "
-                                f"`{fname}` not found")
-                continue
-            n_res = _count_calls(fn, {"resolve_speculate"})
-            if n_res != 1:
-                problems.append(
-                    f"{rel}:{fn.lineno}: `{fname}` calls "
-                    f"resolve_speculate {n_res}x — the knob must be "
-                    f"resolved EXACTLY once at the boundary")
-            if fname in RECOVERY_BOUNDARIES:
-                if _count_calls(fn, {"bounded_retry"}) < 1:
-                    problems.append(
-                        f"{rel}:{fn.lineno}: `{fname}` never routes "
-                        f"through bounded_retry — speculation has no "
-                        f"escalation path")
-                n_fin = _count_calls(fn, FINALIZE_NAMES)
-                if n_fin != 1:
-                    problems.append(
-                        f"{rel}:{fn.lineno}: `{fname}` finalizes "
-                        f"{n_fin}x — the (result, HealthInfo) pair must "
-                        f"resolve ErrorPolicy exactly once")
-    # 6. the raw knob never leaks into a driver module
-    for path in sorted(DRIVERS.glob("*.py")):
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Attribute) and node.attr == "Speculate":
-                problems.append(
-                    f"drivers/{path.name}:{node.lineno}: reads "
-                    f"Option.Speculate directly — drivers consume "
-                    f"resolve_speculate's boolean, never the raw knob")
-    return problems
-
-
-ABFT_MODULE = REPO / "slate_tpu" / "robust" / "abft.py"
-FAULTS_MODULE = REPO / "slate_tpu" / "robust" / "faults.py"
-ABFT_BOUNDARIES = {
-    DRIVERS / "lu.py": ("_getrf",),
-    DRIVERS / "cholesky.py": ("potrf",),
-    DRIVERS / "blas3.py": ("gemm", "trsm"),
-    REPO / "slate_tpu" / "robust" / "recovery.py":
-        ("gesv_with_recovery", "posv_with_recovery"),
-}
-
-
-def _fault_sites() -> set[str]:
-    """The SITES vocabulary, read from faults.py's AST (no import)."""
-    tree = ast.parse(FAULTS_MODULE.read_text(), filename=str(FAULTS_MODULE))
-    for node in tree.body:
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = [t.id for t in node.targets
-                       if isinstance(t, ast.Name)]
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
-                                                            ast.Name):
-            targets = [node.target.id]
-        if "SITES" in targets and node.value is not None:
-            return {c.value for c in ast.walk(node.value)
-                    if isinstance(c, ast.Constant)
-                    and isinstance(c.value, str)}
-    return set()
-
-
-def _check_abft() -> list[str]:
-    problems = []
-    # 7. abft.py: pure mechanism — no options import, no raises
-    if not ABFT_MODULE.exists():
-        problems.append("robust/abft.py: missing (the checksum mechanism "
-                        "module the ABFT layer builds on)")
-        return problems
-    tree = ast.parse(ABFT_MODULE.read_text(), filename=str(ABFT_MODULE))
-    for node in ast.walk(tree):
-        mods = []
-        if isinstance(node, ast.ImportFrom) and node.module:
-            mods = node.module.split(".")
-        elif isinstance(node, ast.Import):
-            mods = [s for a in node.names for s in a.name.split(".")]
-        if "options" in mods:
-            problems.append(
-                f"robust/abft.py:{node.lineno}: imports the options "
-                f"layer — checksum verification must stay policy-free "
-                f"(the seam is the driver boundary's resolve_abft)")
-        if isinstance(node, ast.Raise):
-            problems.append(
-                f"robust/abft.py:{node.lineno}: raises — detection is "
-                f"DATA (AbftCounts folded into HealthInfo); policy "
-                f"resolution lives at the driver boundary")
-    # 8. ABFT boundaries resolve the knob exactly once
-    for path, fns in ABFT_BOUNDARIES.items():
-        rel = path.relative_to(REPO)
-        if not path.exists():
-            problems.append(f"{rel}: missing ABFT boundary module")
-            continue
-        btree = ast.parse(path.read_text(), filename=str(path))
-        defs = {n.name: n for n in btree.body
-                if isinstance(n, ast.FunctionDef)}
-        for fname in fns:
-            fn = defs.get(fname)
-            if fn is None:
-                problems.append(f"{rel}: ABFT boundary `{fname}` "
-                                f"not found")
-                continue
-            n_res = _count_calls(fn, {"resolve_abft"})
-            if n_res != 1:
-                problems.append(
-                    f"{rel}:{fn.lineno}: `{fname}` calls resolve_abft "
-                    f"{n_res}x — the knob must be resolved EXACTLY once "
-                    f"at the boundary")
-    # 9. every maybe_corrupt call names a site literal from faults.SITES
-    sites = _fault_sites()
-    if not sites:
-        problems.append("robust/faults.py: SITES vocabulary not found")
-    for path in sorted((REPO / "slate_tpu").rglob("*.py")):
-        ptree = ast.parse(path.read_text(), filename=str(path))
-        rel = path.relative_to(REPO)
-        for node in ast.walk(ptree):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            name = (f.id if isinstance(f, ast.Name)
-                    else f.attr if isinstance(f, ast.Attribute) else None)
-            if name != "maybe_corrupt" or path == FAULTS_MODULE:
-                continue
-            if not node.args or not (isinstance(node.args[0], ast.Constant)
-                                     and isinstance(node.args[0].value,
-                                                    str)):
-                problems.append(
-                    f"{rel}:{node.lineno}: maybe_corrupt site is not a "
-                    f"string literal — sites must be a closed, greppable "
-                    f"vocabulary")
-            elif sites and node.args[0].value not in sites:
-                problems.append(
-                    f"{rel}:{node.lineno}: maybe_corrupt site "
-                    f"{node.args[0].value!r} not in faults.SITES")
-    # 10. the raw knob never leaks into a driver module
-    for path in sorted(DRIVERS.glob("*.py")):
-        dtree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(dtree):
-            if isinstance(node, ast.Attribute) and node.attr == "Abft":
-                problems.append(
-                    f"drivers/{path.name}:{node.lineno}: reads "
-                    f"Option.Abft directly — drivers consume "
-                    f"resolve_abft's boolean, never the raw knob")
-    return problems
 
 
 def check() -> list[str]:
-    problems = _check_speculation() + _check_abft()
-    for name in CHECKED_MODULES:
-        path = DRIVERS / name
-        if not path.exists():
-            problems.append(f"{name}: missing driver module")
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        if not _imports_robust(tree):
-            problems.append(
-                f"{name}: does not import the robust layer "
-                f"(health/faults/recovery) — failures are not routed "
-                f"through Option.ErrorPolicy")
-        elif not _references_health(tree):
-            problems.append(
-                f"{name}: imports the robust layer but never touches the "
-                f"health machinery (finalize/error_policy/HealthInfo) — "
-                f"no policy is resolved")
-        for fn in _public_functions(tree):
-            if fn.name in EXEMPT:
-                continue
-            if not _accepts_opts(fn):
-                problems.append(
-                    f"{name}:{fn.lineno}: public driver `{fn.name}` "
-                    f"does not accept `opts` — Option.ErrorPolicy cannot "
-                    f"reach it")
-    return problems
+    """Violation report lines, [] when every contract holds."""
+    return legacy_report(load_project(REPO))
 
 
 def main() -> int:

@@ -1,0 +1,21 @@
+"""slate-lint: multi-pass AST analyzer for tpu-slate.
+
+Passes:
+
+1. **reachability** — which functions does jax trace? (entry discovery
+   over jit/shard_map/pallas_call + transitive closure; reachability.py)
+2. **dataflow** — which values inside a traced function are traced?
+   (intraprocedural taint; dataflow.py)
+3. **rules** — trace-safety (TRC0xx), collective discipline (COL0xx),
+   policy-seam contracts (SEAM0xx); rules/
+
+Pure stdlib: the analyzer parses the repo, it never imports it.
+See docs/STATIC_ANALYSIS.md for the rule catalogue.
+"""
+
+from .cli import main, run_rules  # noqa: F401
+from .loader import load_project  # noqa: F401
+from .model import REGISTRY, Finding, Rule, register  # noqa: F401
+
+__all__ = ["main", "run_rules", "load_project", "REGISTRY", "Finding",
+           "Rule", "register"]

@@ -1,0 +1,154 @@
+"""slate-lint CLI.
+
+Usage::
+
+    python -m tools.slate_lint [--root DIR] [--format human|json]
+                               [--select RULES] [--baseline FILE]
+                               [--update-baseline] [--list-rules]
+
+Exit codes: 0 clean (no findings outside the baseline), 1 findings,
+2 usage / internal error.
+
+The baseline is a JSON list of line-free fingerprints
+``[rule, path, message]`` — known findings that are tolerated but must
+not grow.  ``--update-baseline`` rewrites it from the current findings;
+the checked-in ``tools/slate_lint/baseline.json`` is empty and the repo
+is expected to stay clean (suppress intentional sites inline with a
+reason instead of baselining them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .loader import load_project
+from .model import REGISTRY, Finding
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_rules():
+    from . import rules  # noqa: F401  (populates REGISTRY on import)
+    return REGISTRY
+
+
+def run_rules(project, select: set[str] | None = None) -> list[Finding]:
+    registry = load_rules()
+    findings: list[Finding] = []
+    for rule_id, rule in registry.items():
+        if select is not None and rule_id not in select:
+            continue
+        for f in rule.run(project):
+            mod = project.module(f.path)
+            if mod is not None and mod.suppressed(f.line, f.rule):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings
+
+
+def read_baseline(path: Path) -> list[tuple[str, str, str]]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text() or "[]")
+    return [tuple(entry) for entry in data]
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: list[tuple[str, str, str]]
+                   ) -> tuple[list[Finding], list[tuple[str, str, str]]]:
+    """Split findings into (new, unmatched-baseline-entries).  Matching is
+    multiset-aware: N baselined copies of a fingerprint absorb N findings."""
+    budget: dict[tuple[str, str, str], int] = {}
+    for fp in baseline:
+        budget[fp] = budget.get(fp, 0) + 1
+    new: list[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+        else:
+            new.append(f)
+    stale = [fp for fp, n in budget.items() for _ in range(n)]
+    return new, stale
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="slate-lint",
+        description="AST lint for trace-safety, collective discipline, "
+                    "and policy-seam contracts (pure stdlib, no jax).")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of tools/)")
+    ap.add_argument("--format", choices=("human", "json"), default="human")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE.name} "
+                         f"next to the package)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings and "
+                         "exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    registry = load_rules()
+    if args.list_rules:
+        for rule_id, rule in sorted(registry.items()):
+            print(f"{rule_id}  {rule.summary}")
+        return 0
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parents[2]
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = select - registry.keys()
+        if unknown:
+            print(f"unknown rule ids: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    project = load_project(root)
+    findings = run_rules(project, select)
+
+    baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+    if args.update_baseline:
+        baseline_path.write_text(json.dumps(
+            [list(f.fingerprint()) for f in findings], indent=1) + "\n")
+        print(f"baseline updated: {len(findings)} finding(s) -> "
+              f"{baseline_path}")
+        return 0
+
+    baseline = read_baseline(baseline_path)
+    new, stale = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_baseline": [list(fp) for fp in stale],
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    if stale:
+        print(f"note: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer fire "
+              f"(run --update-baseline)", file=sys.stderr)
+    if new:
+        print(f"\nslate-lint: {len(new)} finding(s) "
+              f"({len(findings) - len(new)} baselined)", file=sys.stderr)
+        return 1
+    print(f"slate-lint OK: {len(registry) if select is None else len(select)}"
+          f" rule(s), {len(project.modules)} file(s), "
+          f"{len(findings) - len(new)} baselined finding(s)")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""Intraprocedural taint: which local names hold TRACED array values.
+
+Seeds
+-----
+- results of ``jnp.*`` / ``lax.*`` / ``jax.numpy.*`` calls (minus the
+  :data:`STATIC_JNP_FNS` whose results are trace-time-static python
+  values: dtype queries, finfo, ...);
+- parameters, by the reachability pass's policy: every non-static
+  parameter of a direct entry or of a nested def inside a traced
+  function; only array-annotated parameters (``jax.Array``, ``Array``,
+  ``jnp.ndarray``, ``ArrayLike``) of transitively-traced module-level
+  functions — those may legitimately take static config ints;
+- free variables tainted in the enclosing function (closures: a
+  ``fori_loop`` body reads the traced carry of its builder).
+
+Propagation
+-----------
+Assignments taint their targets when the RHS is tainted; taint flows
+through subscripts, arithmetic, ``.T``/``.astype``-style attribute and
+method chains, and calls with tainted arguments.  It does NOT flow
+through the trace-time-static escape hatches: ``.shape`` / ``.ndim`` /
+``.size`` / ``.dtype`` attribute reads and the :data:`STATIC_JNP_FNS`.
+
+The analysis is flow-insensitive (a fixpoint over the function body),
+which overtaints across re-bindings — fine for lint, where the cost of a
+false positive is one explicit suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .reachability import FuncInfo, own_nodes
+
+#: module aliases whose attribute calls produce traced arrays
+ARRAY_NS_DOTTED = {"jax.numpy", "jax.lax", "jnp", "lax"}
+#: jnp/lax functions returning trace-time-static python values
+STATIC_JNP_FNS = {
+    "issubdtype", "isdtype", "iinfo", "finfo", "result_type",
+    "promote_types", "dtype", "ndim", "shape", "size", "can_cast",
+    "iscomplexobj", "isrealobj",  # dtype queries: static even on tracers
+}
+#: builtins whose RESULT is always a host value even on traced args
+#: (len/isinstance/getattr never call __bool__ on a tracer)
+STATIC_RESULT_BUILTINS = {
+    "len", "isinstance", "issubclass", "getattr", "hasattr", "type",
+    "range", "enumerate", "callable", "id", "repr", "str",
+}
+#: attribute reads on a tracer that are static at trace time
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "sharding"}
+#: python builtins that force concretization of their argument
+CONCRETIZERS = {"bool", "float", "int", "complex"}
+#: method calls that force concretization of their receiver
+CONCRETIZING_METHODS = {"item", "tolist", "__bool__", "__float__",
+                        "__int__"}
+#: annotations marking a parameter as an array for taint seeding
+ARRAY_ANNOTATIONS = {"Array", "jax.Array", "jnp.ndarray", "ndarray",
+                     "ArrayLike", "jax.typing.ArrayLike"}
+
+
+def _ann_text(ann: ast.AST | None) -> str:
+    if ann is None:
+        return ""
+    try:
+        return ast.unparse(ann)
+    except Exception:  # pragma: no cover - unparse is total on valid ast
+        return ""
+
+
+def array_namespace_aliases(imports: dict[str, str]) -> set[str]:
+    """Names bound to jax.numpy / jax.lax in a module (jnp, lax, ...)."""
+    out = {name for name, dotted in imports.items()
+           if dotted in ARRAY_NS_DOTTED}
+    out.update(n for n in ("jnp", "lax") if n in imports or n in out)
+    return out
+
+
+class TaintAnalysis:
+    """Taint for one function; ``tainted`` is the fixpoint name set."""
+
+    def __init__(self, info: FuncInfo, ns_aliases: set[str],
+                 direct_fns: set[str], taint_all_params: bool,
+                 inherited: frozenset[str] = frozenset()):
+        self.info = info
+        self.ns = ns_aliases          # jnp/lax-style module aliases
+        self.direct_fns = direct_fns  # names imported straight from jnp/lax
+        self.tainted: set[str] = set(inherited)
+        self._seed_params(taint_all_params)
+        self._fixpoint()
+
+    # ---- seeding ------------------------------------------------------
+
+    def _seed_params(self, all_params: bool):
+        defaulted = self._defaulted_params()
+        for arg in self.info.params():
+            if arg.arg in self.info.static_params:
+                continue
+            if all_params:
+                # non-entry nested defs (fori_loop/scan bodies): a
+                # defaulted parameter is the static-capture idiom
+                # (``def step(k, c, W0=W0)``) — the loop combinator only
+                # ever feeds the non-defaulted ones
+                if arg.arg in defaulted and not self.info.is_entry:
+                    continue
+                self.tainted.add(arg.arg)
+            elif any(a in _ann_text(arg.annotation)
+                     for a in ARRAY_ANNOTATIONS):
+                self.tainted.add(arg.arg)
+
+    def _defaulted_params(self) -> set[str]:
+        a = self.info.node.args
+        pos = [*a.posonlyargs, *a.args]
+        out = {arg.arg for arg in pos[len(pos) - len(a.defaults):]}
+        out.update(arg.arg for arg, d in zip(a.kwonlyargs, a.kw_defaults)
+                   if d is not None)
+        return out
+
+    # ---- expression taint --------------------------------------------
+
+    def is_array_ns(self, expr: ast.AST) -> bool:
+        """Is ``expr`` (a call's func) a jnp/lax-namespace function?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in self.direct_fns
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_JNP_FNS:
+                return False
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in self.ns:
+                return True
+            # jax.numpy.fn / jax.lax.fn spelled in full
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "jax"
+                    and base.attr in ("numpy", "lax")):
+                return True
+        return False
+
+    def expr_tainted(self, expr: ast.AST | None) -> bool:
+        if expr is None:
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False
+            return self.expr_tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            return self.call_tainted(expr)
+        if isinstance(expr, ast.Lambda):
+            return False  # a function object, not a value
+        if isinstance(expr, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+            return False  # identity tests never concretize (`x is None`)
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            return any(self.expr_tainted(g.iter) for g in expr.generators)
+        return any(self.expr_tainted(c)
+                   for c in ast.iter_child_nodes(expr)
+                   if isinstance(c, ast.expr))
+
+    def call_tainted(self, call: ast.Call) -> bool:
+        f = call.func
+        if self.is_array_ns(f):
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in STATIC_JNP_FNS:
+            return False
+        if isinstance(f, ast.Name):
+            if f.id in CONCRETIZERS:  # host scalar out (and a sink)
+                return False
+            if f.id in STATIC_RESULT_BUILTINS:
+                return False  # host-level result regardless of args
+            if f.id in ("zip", "min", "max", "abs", "sum", "tuple", "list",
+                        "dict", "set", "sorted"):
+                # value passthrough: traced in -> traced out
+                return any(self.expr_tainted(a) for a in call.args)
+        if isinstance(f, ast.Attribute):
+            if f.attr in CONCRETIZING_METHODS:
+                return False  # host value out (and a sink)
+            if self.expr_tainted(f.value):  # method on a traced array
+                return True
+        return (any(self.expr_tainted(a) for a in call.args)
+                or any(self.expr_tainted(kw.value) for kw in call.keywords))
+
+    # ---- statement fixpoint ------------------------------------------
+
+    def _assign_targets(self, target: ast.AST):
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign_targets(elt)
+        elif isinstance(target, ast.Starred):
+            self._assign_targets(target.value)
+        # attribute/subscript stores don't create locals
+
+    def _fixpoint(self):
+        changed = True
+        while changed:
+            before = len(self.tainted)
+            for node in own_nodes(self.info.node):
+                if isinstance(node, ast.Assign):
+                    if self.expr_tainted(node.value):
+                        for t in node.targets:
+                            self._assign_targets(t)
+                elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                    if self.expr_tainted(node.value):
+                        self._assign_targets(node.target)
+                elif isinstance(node, ast.NamedExpr):
+                    if self.expr_tainted(node.value):
+                        self._assign_targets(node.target)
+                elif isinstance(node, ast.For):
+                    if self.expr_tainted(node.iter):
+                        self._assign_targets(node.target)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)):
+                    for gen in node.generators:
+                        if self.expr_tainted(gen.iter):
+                            self._assign_targets(gen.target)
+                elif isinstance(node, ast.withitem):
+                    if node.optional_vars is not None and \
+                            self.expr_tainted(node.context_expr):
+                        self._assign_targets(node.optional_vars)
+            changed = len(self.tainted) != before
+
+
+def analyze(info: FuncInfo, imports: dict[str, str],
+            taint_all_params: bool,
+            inherited: frozenset[str] = frozenset()) -> TaintAnalysis:
+    ns = array_namespace_aliases(imports)
+    direct = {name for name, dotted in imports.items()
+              if any(dotted == f"{m}.{name.split('.')[-1]}" or
+                     dotted.startswith(f"{m}.")
+                     for m in ("jax.numpy", "jax.lax"))
+              and dotted.rsplit(".", 1)[-1] not in STATIC_JNP_FNS}
+    return TaintAnalysis(info, ns, direct, taint_all_params, inherited)

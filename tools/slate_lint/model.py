@@ -1,0 +1,102 @@
+"""Core data model: findings, the rule registry, suppressions.
+
+A *rule* is a plugin: a class with an ``id``, a one-line ``summary``, and
+a ``run(project)`` generator of :class:`Finding`.  Rules register
+themselves with the :func:`register` decorator; the engine discovers them
+through :data:`REGISTRY` (populated by importing ``tools.slate_lint.rules``).
+
+Suppressions are per-line comments::
+
+    x = risky()  # slate-lint: disable=TRC001 -- trace-time shape probe
+
+A standalone suppression comment (a line that is only the comment)
+applies to the next statement line instead, so long call chains can be
+annotated without breaking the line.  The ``-- reason`` tail is required
+policy for intentional suppressions (docs/STATIC_ANALYSIS.md) but not
+enforced syntactically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+SUPPRESS_RE = re.compile(
+    r"#\s*slate-lint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:--\s*(?P<reason>.*))?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule id, repo-relative posix path, 1-based line.
+
+    ``legacy`` carries the exact report text of the pre-slate_lint
+    ``tools/check_error_contracts.py`` for the migrated seam rules, so the
+    shim can reproduce its output byte-for-byte.
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    legacy: str | None = None
+
+    def fingerprint(self) -> tuple[str, str, str]:
+        """Line-free identity used for baseline matching — stable across
+        unrelated edits that only shift line numbers."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+class Rule:
+    """Base class for rule plugins.  Subclasses set ``id`` and ``summary``
+    and implement ``run``."""
+
+    id: str = ""
+    summary: str = ""
+
+    def run(self, project):  # pragma: no cover - interface
+        raise NotImplementedError
+        yield
+
+
+#: rule id -> Rule instance, in registration order
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    """Class decorator adding a rule to :data:`REGISTRY`."""
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if inst.id in REGISTRY:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    REGISTRY[inst.id] = inst
+    return cls
+
+
+def parse_suppressions(comment_lines: list[tuple[int, str, bool]]
+                       ) -> dict[int, set[str]]:
+    """Map line numbers to the rule ids suppressed there.
+
+    ``comment_lines`` is ``(lineno, comment_text, standalone)`` per comment
+    token; a standalone comment suppresses the following line as well (the
+    next physical line — put standalone suppressions directly above the
+    statement they target).
+    """
+    out: dict[int, set[str]] = {}
+    for lineno, text, standalone in comment_lines:
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(lineno, set()).update(rules)
+        if standalone:
+            out.setdefault(lineno + 1, set()).update(rules)
+    return out

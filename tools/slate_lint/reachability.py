@@ -1,0 +1,361 @@
+"""Call-graph / reachability pass: which functions are TRACED.
+
+A function is traced when jax traces it rather than running it eagerly:
+
+- **direct entries** — decorated with ``@jax.jit`` / ``@jit`` /
+  ``@partial(jit, ...)``, or passed as the callable to ``jax.jit(f)``,
+  ``jax.shard_map(f, ...)``, ``shard_map_unchecked(f, ...)`` (the compat
+  shim in ``util/compat_jax.py``) or ``pl.pallas_call(kernel, ...)``;
+- **transitively traced** — reachable from a traced function through the
+  lexically-resolvable call graph: direct calls, bare function references
+  (e.g. a body handed to ``lax.fori_loop`` / ``lax.scan``), and nested
+  ``def``\\ s of traced functions.
+
+Resolution is lexical and best-effort: a ``Name`` resolves through the
+enclosing-function chain, then module-level ``def``\\ s, then the module's
+import map (``from ..internal import gemm`` makes ``gemm.fn`` resolvable).
+Known false-negative edges — dynamic dispatch through dicts of functions
+built at runtime, ``getattr``, re-exports through ``__init__`` — are
+documented in docs/STATIC_ANALYSIS.md; the repo's kernel layers are
+written in the resolvable style.
+
+Entries created with ``jax.jit(lambda ...: f(...))`` contribute their
+lambda body's resolvable callees as traced roots (the lambda itself is
+not modelled as a function).
+"""
+
+from __future__ import annotations
+
+import ast
+from .loader import Project, SourceModule
+
+#: wrappers whose first callable argument becomes a traced entry
+ENTRY_WRAPPERS = {"jit", "shard_map", "shard_map_unchecked", "pallas_call"}
+#: jit-like wrappers that honour static_argnames
+JIT_LIKE = {"jit"}
+
+
+class FuncInfo:
+    """One ``def`` in the project, with resolution results."""
+
+    def __init__(self, key: str, node: ast.FunctionDef,
+                 module: SourceModule, parent: "FuncInfo | None"):
+        self.key = key              # "<rel>::<dotted nesting path>"
+        self.node = node
+        self.module = module
+        self.parent = parent
+        self.children: dict[str, "FuncInfo"] = {}
+        self.is_entry = False
+        self.static_params: set[str] = set()
+        self.resolved_calls: set[str] = set()   # keys of called functions
+        self.resolved_refs: set[str] = set()    # keys of referenced functions
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def qual(self) -> str:
+        return self.key.split("::", 1)[1]
+
+    def params(self) -> list[ast.arg]:
+        a = self.node.args
+        return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+
+def _nested_defs(fn_node: ast.AST):
+    """Yield the defs whose NEAREST enclosing def is ``fn_node`` (deeper
+    nesting is indexed recursively under its own parent)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def own_nodes(fn_node: ast.AST):
+    """Walk a function body without descending into nested ``def``\\ s
+    (those are separate FuncInfos); lambda bodies ARE included."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _import_map(mod: SourceModule) -> dict[str, str]:
+    """Local name -> dotted target for module-level imports."""
+    parts = mod.dotted.split(".")
+    is_pkg = mod.rel.endswith("__init__.py")
+    pkg = parts if is_pkg else parts[:-1]
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg[: len(pkg) - (node.level - 1)]
+                prefix = ".".join(base + (node.module.split(".")
+                                          if node.module else []))
+            else:
+                prefix = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = (
+                    f"{prefix}.{alias.name}" if prefix else alias.name)
+    return out
+
+
+class Reachability:
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: dict[str, FuncInfo] = {}
+        self.module_funcs: dict[str, dict[str, str]] = {}  # rel -> name->key
+        self.imports: dict[str, dict[str, str]] = {}       # rel -> name->dotted
+        self.entries: set[str] = set()
+        self.traced: set[str] = set()
+        self._index()
+        self._resolve_and_find_entries()
+        self._closure()
+
+    # ---- indexing -----------------------------------------------------
+
+    def _index(self):
+        for rel, mod in self.project.modules.items():
+            self.imports[rel] = _import_map(mod)
+            table: dict[str, str] = {}
+
+            def add(node, parent: FuncInfo | None, prefix: str):
+                qual = f"{prefix}{node.name}" if prefix else node.name
+                info = FuncInfo(f"{rel}::{qual}", node, mod, parent)
+                self.functions[info.key] = info
+                if parent is None:
+                    table[node.name] = info.key
+                else:
+                    parent.children[node.name] = info
+                for child in _nested_defs(node):
+                    add(child, info, f"{qual}.")
+                return info
+
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(node, None, "")
+            self.module_funcs[rel] = table
+
+    # ---- name resolution ---------------------------------------------
+
+    def resolve_name(self, name: str, scope: FuncInfo | None,
+                     rel: str) -> str | None:
+        """Resolve a bare name at a scope to a function key."""
+        fn = scope
+        while fn is not None:
+            if name in fn.children:
+                return fn.children[name].key
+            fn = fn.parent
+        if name in self.module_funcs.get(rel, ()):
+            return self.module_funcs[rel][name]
+        dotted = self.imports.get(rel, {}).get(name)
+        if dotted:
+            return self._resolve_dotted(dotted)
+        return None
+
+    def resolve_attr(self, base: str, attr: str, rel: str) -> str | None:
+        """Resolve ``base.attr`` where base is an imported module alias."""
+        dotted = self.imports.get(rel, {}).get(base)
+        if dotted:
+            return self._resolve_dotted(f"{dotted}.{attr}")
+        return None
+
+    def _resolve_dotted(self, dotted: str) -> str | None:
+        """``pkg.mod.fn`` -> key, when pkg.mod is a project module."""
+        if dotted in self.project.by_dotted:  # a module, not a function
+            return None
+        mod_name, _, fn_name = dotted.rpartition(".")
+        mod = self.project.by_dotted.get(mod_name)
+        if mod is None:
+            return None
+        return self.module_funcs.get(mod.rel, {}).get(fn_name)
+
+    def resolve_call_target(self, call: ast.Call, scope: FuncInfo | None,
+                            rel: str) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.resolve_name(f.id, scope, rel)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            return self.resolve_attr(f.value.id, f.attr, rel)
+        return None
+
+    # ---- entry discovery ---------------------------------------------
+
+    @staticmethod
+    def _callable_name(expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            return expr.attr
+        return None
+
+    @staticmethod
+    def _static_argnames(keywords) -> set[str]:
+        out: set[str] = set()
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                                  str):
+                        out.add(c.value)
+        return out
+
+    def _mark_entry(self, key: str | None, static: set[str] = frozenset()):
+        """Mark ``key`` as a traced entry.  ``static`` is the set of its
+        parameters that are trace-time-static AT THIS ENTRY SITE; a
+        parameter is recorded static only if it is static at EVERY site
+        (intersection), since any one traced binding makes it traced."""
+        if key is None:
+            return
+        info = self.functions[key]
+        if info.is_entry:
+            info.static_params &= set(static)
+        else:
+            info.is_entry = True
+            info.static_params = set(static)
+        self.entries.add(key)
+
+    def _resolve_and_find_entries(self):
+        for key, info in self.functions.items():
+            rel = info.module.rel
+            # decorators
+            for dec in info.node.decorator_list:
+                name = self._callable_name(dec)
+                if name in JIT_LIKE:
+                    self._mark_entry(key)
+                elif isinstance(dec, ast.Call):
+                    cname = self._callable_name(dec.func)
+                    if cname in JIT_LIKE:
+                        self._mark_entry(key,
+                                         self._static_argnames(dec.keywords))
+                    elif cname == "partial" and dec.args:
+                        inner = self._callable_name(dec.args[0])
+                        if inner in JIT_LIKE:
+                            self._mark_entry(
+                                key, self._static_argnames(dec.keywords))
+            # body: calls, references, wrapper args
+            for node in own_nodes(info.node):
+                if isinstance(node, ast.Call):
+                    target = self.resolve_call_target(node, info, rel)
+                    if target:
+                        info.resolved_calls.add(target)
+                    wname = self._callable_name(node.func)
+                    if wname in ENTRY_WRAPPERS and node.args:
+                        self._wrapper_entry(node, info, rel, wname)
+                elif isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    target = self.resolve_name(node.id, info, rel)
+                    if target:
+                        info.resolved_refs.add(target)
+                elif (isinstance(node, ast.Attribute)
+                      and isinstance(node.ctx, ast.Load)
+                      and isinstance(node.value, ast.Name)):
+                    target = self.resolve_attr(node.value.id, node.attr, rel)
+                    if target:
+                        info.resolved_refs.add(target)
+        # module-level wrapper calls (entry built at import time);
+        # own_nodes skips def bodies — those were handled above
+        for rel, mod in self.project.modules.items():
+            for node in own_nodes(mod.tree):
+                if isinstance(node, ast.Call):
+                    wname = self._callable_name(node.func)
+                    if wname in ENTRY_WRAPPERS and node.args:
+                        self._wrapper_entry(node, None, rel, wname)
+
+    def _wrapper_entry(self, call: ast.Call, scope: FuncInfo | None,
+                       rel: str, wname: str):
+        static = (self._static_argnames(call.keywords)
+                  if wname in JIT_LIKE else set())
+        target = call.args[0]
+        if isinstance(target, ast.Name):
+            self._mark_entry(self.resolve_name(target.id, scope, rel),
+                             static)
+        elif isinstance(target, ast.Lambda):
+            # the lambda body is traced: its resolvable callees are roots.
+            # Only arguments fed from the LAMBDA'S OWN parameters are
+            # traced; closure-bound arguments (``Nt=Nt``, ``lower=lower``
+            # — the shard_map static-config idiom) are trace-time-static.
+            lam_params = {a.arg for a in (*target.args.posonlyargs,
+                                          *target.args.args,
+                                          *target.args.kwonlyargs)}
+            for node in ast.walk(target.body):
+                if isinstance(node, ast.Call):
+                    key = self.resolve_call_target(node, scope, rel)
+                    if key:
+                        self._mark_entry(
+                            key, self._lambda_statics(node, key, lam_params))
+
+    def _lambda_statics(self, call: ast.Call, key: str,
+                        lam_params: set[str]) -> set[str]:
+        """Callee parameters bound (or defaulted) to closure values rather
+        than to the traced lambda parameters."""
+        def feeds_traced(expr: ast.AST) -> bool:
+            return any(isinstance(n, ast.Name) and n.id in lam_params
+                       for n in ast.walk(expr))
+
+        callee = self.functions[key]
+        names = [a.arg for a in callee.params()]
+        traced: set[str] = set()
+        has_starred = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                has_starred = True
+                if feeds_traced(arg.value):
+                    traced.update(names[i:])
+            elif feeds_traced(arg) and i < len(names):
+                traced.add(names[i])
+        for kw in call.keywords:
+            if kw.arg is None:  # **kwargs: can't map, be conservative
+                if feeds_traced(kw.value):
+                    traced.update(names)
+            elif feeds_traced(kw.value):
+                traced.add(kw.arg)
+        if has_starred and callee.node.args.vararg:
+            return set()  # positions unknowable: keep everything traced
+        return set(names) - traced
+
+    # ---- transitive closure ------------------------------------------
+
+    def _closure(self):
+        frontier = list(self.entries)
+        self.traced = set(frontier)
+        while frontier:
+            key = frontier.pop()
+            info = self.functions[key]
+            nxt = (info.resolved_calls | info.resolved_refs
+                   | {c.key for c in info.children.values()})
+            for t in nxt:
+                if t not in self.traced:
+                    self.traced.add(t)
+                    frontier.append(t)
+
+    # ---- taint seeding policy ----------------------------------------
+
+    def taint_all_params(self, info: FuncInfo) -> bool:
+        """Entry functions and nested defs of traced functions run with
+        every (non-static) parameter traced; transitively-traced
+        module-level functions may also take static config, so only their
+        array-annotated parameters seed taint (dataflow.py)."""
+        if info.is_entry:
+            return True
+        return (info.key in self.traced and info.parent is not None
+                and info.parent.key in self.traced)
+
+
+def compute(project: Project) -> Reachability:
+    if "reachability" not in project.cache:
+        project.cache["reachability"] = Reachability(project)
+    return project.cache["reachability"]

@@ -1,0 +1,344 @@
+"""Collective-discipline rules (COL0xx).
+
+The mesh contract (SURVEY §runtime, core/grid.py): there is exactly one
+axis vocabulary — the ``AXIS_*`` constants in ``slate_tpu/core/grid.py``
+(``AXIS_P = "p"``, ``AXIS_Q = "q"``), the names every ``Mesh`` in the
+framework is built with.  Collectives must name axes through those
+constants (or through a parameter of a generic wrapper, the
+``comm/collectives.py`` pattern) so a rename in grid.py cannot silently
+strand a ``psum`` on a dead axis name.
+
+Rules:
+
+- **COL001** — a collective names an axis the analyzer cannot tie to the
+  mesh vocabulary (unknown name, non-vocabulary literal, computed expr).
+- **COL002** — a collective hard-codes a vocabulary axis name as a string
+  literal ("p"/"q") instead of the AXIS_* constant: works today, drifts
+  silently when grid.py is renamed.
+- **COL003** — a collective appears under exactly one branch of a
+  ``lax.cond``/``lax.switch``: if the predicate is not mesh-uniform the
+  ranks that take the other branch never enter the collective and the
+  mesh deadlocks.  Mesh-uniform predicates (a replicated fori_loop bound)
+  are legitimate — suppress with a reason stating WHY the predicate is
+  uniform.
+- **COL004** — ``io_callback``/``pure_callback`` outside the registered
+  fault-consumption module (robust/faults.py): host callbacks are
+  ordering hazards inside collective programs and are allowed only at
+  the audited fault-injection seam.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import reachability
+from ..model import Finding, Rule, register
+
+#: lax collective primitives (and the repo's comm/collectives.py wrappers)
+#: -> positional index of the axis-name arg
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1, "axis_index": 0,
+    "pbroadcast": 1, "pvary": 1,
+    # comm/collectives.py wrappers: the axis flows through verbatim
+    "bcast_along": 2, "reduce_along": 1, "reduce_scatter_along": 1,
+    "allgather_along": 1, "pargmax": 2, "ppermute_shift": 1,
+}
+#: functions treated as collectives for branch-divergence purposes
+COLLECTIVE_NAMES = set(COLLECTIVE_AXIS_ARG)
+#: host-callback callables restricted by COL004
+CALLBACK_NAMES = {"io_callback", "pure_callback"}
+#: the registered fault-consumption module (the only callback seam)
+ALLOWED_CALLBACK_MODULES = {"slate_tpu/robust/faults.py"}
+#: where the axis vocabulary lives
+GRID_MODULE_SUFFIX = "core/grid.py"
+
+_OK, _LITERAL, _UNKNOWN_LITERAL, _UNKNOWN = range(4)
+
+
+def axis_vocabulary(project) -> tuple[str | None, dict[str, str]]:
+    """(grid module dotted name, {AXIS_CONST -> "name"}) read from the
+    project's core/grid.py AST."""
+    if "axis_vocab" in project.cache:
+        return project.cache["axis_vocab"]
+    dotted, consts = None, {}
+    for rel, mod in project.modules.items():
+        if not rel.endswith(GRID_MODULE_SUFFIX):
+            continue
+        dotted = mod.dotted
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id.startswith("AXIS_"):
+                        consts[t.id] = node.value.value
+        break
+    project.cache["axis_vocab"] = (dotted, consts)
+    return dotted, consts
+
+
+def _collective_call(node: ast.Call) -> str | None:
+    f = node.func
+    name = (f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None)
+    return name if name in COLLECTIVE_NAMES else None
+
+
+def _axis_expr(node: ast.Call, name: str) -> ast.AST | None:
+    for kw in node.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    idx = COLLECTIVE_AXIS_ARG[name]
+    if len(node.args) > idx:
+        return node.args[idx]
+    return None
+
+
+class _AxisClassifier:
+    """Classify an axis-name expression at a call site."""
+
+    def __init__(self, project, reach, info: reachability.FuncInfo | None,
+                 rel: str):
+        self.reach = reach
+        self.rel = rel
+        self.info = info
+        self.grid_dotted, self.consts = axis_vocabulary(project)
+        self.vocab = set(self.consts.values())
+        # one-level local env: names assigned directly from an AXIS_*
+        # constant inside the enclosing function chain count as OK
+        self.local_ok: set[str] = set()
+        fn = info
+        while fn is not None:
+            for n in reachability.own_nodes(fn.node):
+                if isinstance(n, ast.Assign) and self._is_const(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            self.local_ok.add(t.id)
+            fn = fn.parent
+
+    def _is_const(self, expr: ast.AST) -> bool:
+        """Is ``expr`` a reference to a vocabulary AXIS_* constant?"""
+        if isinstance(expr, ast.Name):
+            if expr.id in self.consts and \
+                    self.rel.endswith(GRID_MODULE_SUFFIX):
+                return True  # inside grid.py itself
+            dotted = self.reach.imports.get(self.rel, {}).get(expr.id)
+            return bool(
+                dotted and self.grid_dotted
+                and dotted.startswith(self.grid_dotted + ".")
+                and dotted.rsplit(".", 1)[1] in self.consts)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            dotted = self.reach.imports.get(self.rel, {}).get(expr.value.id)
+            return bool(dotted == self.grid_dotted
+                        and expr.attr in self.consts)
+        return False
+
+    def _is_param(self, name: str) -> bool:
+        fn = self.info
+        while fn is not None:
+            if any(a.arg == name for a in fn.params()):
+                return True
+            fn = fn.parent
+        return False
+
+    def classify(self, expr: ast.AST) -> int:
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            kinds = [self.classify(e) for e in expr.elts]
+            return max(kinds, default=_UNKNOWN)
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return _LITERAL if expr.value in self.vocab else _UNKNOWN_LITERAL
+        if self._is_const(expr):
+            return _OK
+        if isinstance(expr, ast.Name):
+            if self._is_param(expr.id) or expr.id in self.local_ok:
+                return _OK
+            return _UNKNOWN
+        return _UNKNOWN
+
+
+def _iter_function_scopes(project):
+    """(scope FuncInfo or None, module) covering every node exactly once."""
+    reach = reachability.compute(project)
+    for key in sorted(reach.functions):
+        yield reach, reach.functions[key], reach.functions[key].module
+    for rel in sorted(project.modules):
+        yield reach, None, project.modules[rel]
+
+
+def _scope_nodes(scope, module):
+    root = scope.node if scope is not None else module.tree
+    return reachability.own_nodes(root)
+
+
+@register
+class AxisNameUnknown(Rule):
+    id = "COL001"
+    summary = ("collective names an axis not tied to the mesh vocabulary "
+               "in core/grid.py (unknown name, computed expr, or "
+               "non-vocabulary literal)")
+
+    def run(self, project):
+        for reach, scope, module in _iter_function_scopes(project):
+            clf = None
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _collective_call(node)
+                if cname is None:
+                    continue
+                axis = _axis_expr(node, cname)
+                if axis is None:
+                    continue
+                if clf is None:
+                    clf = _AxisClassifier(project, reach, scope, module.rel)
+                if clf.classify(axis) in (_UNKNOWN, _UNKNOWN_LITERAL):
+                    yield Finding(
+                        self.id, module.rel, node.lineno,
+                        f"`{cname}` names an axis the analyzer cannot tie "
+                        f"to the mesh axis vocabulary "
+                        f"({sorted(clf.vocab) or 'none found'}) — use the "
+                        f"AXIS_* constants from core/grid.py or a "
+                        f"parameter of a generic wrapper")
+
+
+@register
+class AxisNameLiteral(Rule):
+    id = "COL002"
+    summary = ("collective hard-codes a mesh axis name as a string "
+               "literal — use the AXIS_* constants from core/grid.py")
+
+    def run(self, project):
+        for reach, scope, module in _iter_function_scopes(project):
+            clf = None
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _collective_call(node)
+                if cname is None:
+                    continue
+                axis = _axis_expr(node, cname)
+                if axis is None:
+                    continue
+                if clf is None:
+                    clf = _AxisClassifier(project, reach, scope, module.rel)
+                if clf.classify(axis) == _LITERAL:
+                    yield Finding(
+                        self.id, module.rel, node.lineno,
+                        f"`{cname}` hard-codes the axis name — a literal "
+                        f"matches the mesh today but drifts silently if "
+                        f"core/grid.py renames it; use AXIS_P/AXIS_Q")
+
+
+class _CollectiveReach:
+    """Transitive does-this-function-execute-a-collective memo."""
+
+    def __init__(self, reach):
+        self.reach = reach
+        self.memo: dict[str, bool] = {}
+
+    def contains(self, key: str) -> bool:
+        if key in self.memo:
+            return self.memo[key]
+        self.memo[key] = False  # cycle guard
+        info = self.reach.functions.get(key)
+        if info is None:
+            return False
+        direct = any(
+            isinstance(n, ast.Call) and _collective_call(n)
+            for n in reachability.own_nodes(info.node))
+        result = direct or any(
+            self.contains(t)
+            for t in (info.resolved_calls | info.resolved_refs
+                      | {c.key for c in info.children.values()}))
+        self.memo[key] = result
+        return result
+
+    def branch_has(self, expr: ast.AST, scope, rel: str) -> bool | None:
+        """Does a branch callable execute a collective?  None: can't tell."""
+        if isinstance(expr, ast.Lambda):
+            if any(isinstance(n, ast.Call) and _collective_call(n)
+                   for n in ast.walk(expr)):
+                return True
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call):
+                    t = self.reach.resolve_call_target(n, scope, rel)
+                    if t and self.contains(t):
+                        return True
+            return False
+        if isinstance(expr, ast.Name):
+            t = self.reach.resolve_name(expr.id, scope, rel)
+            return self.contains(t) if t else None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            t = self.reach.resolve_attr(expr.value.id, expr.attr, rel)
+            return self.contains(t) if t else None
+        return None
+
+
+@register
+class CollectiveUnderCond(Rule):
+    id = "COL003"
+    summary = ("collective under exactly one branch of lax.cond/"
+               "lax.switch — a non-uniform predicate deadlocks the mesh")
+
+    def run(self, project):
+        reach = reachability.compute(project)
+        creach = _CollectiveReach(reach)
+        for _, scope, module in _iter_function_scopes(project):
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+                branches: list[ast.AST] = []
+                if name == "cond" and len(node.args) >= 3:
+                    branches = [node.args[1], node.args[2]]
+                elif name == "switch" and len(node.args) >= 2 and \
+                        isinstance(node.args[1], (ast.List, ast.Tuple)):
+                    branches = list(node.args[1].elts)
+                if len(branches) < 2:
+                    continue
+                has = [creach.branch_has(b, scope, module.rel)
+                       for b in branches]
+                if None in has:
+                    continue  # unresolvable branch: stay silent
+                if any(has) and not all(has):
+                    yield Finding(
+                        self.id, module.rel, node.lineno,
+                        f"collective under one branch of `{name}` but not "
+                        f"the other(s) — ranks taking the collective-free "
+                        f"branch would deadlock the mesh unless the "
+                        f"predicate is replicated-uniform; restructure, "
+                        f"or suppress stating why the predicate is "
+                        f"uniform on every rank")
+
+
+@register
+class CallbackOutsideFaultSeam(Rule):
+    id = "COL004"
+    summary = ("io_callback/pure_callback outside the registered "
+               "fault-consumption seam (robust/faults.py)")
+
+    def run(self, project):
+        for rel in sorted(project.modules):
+            if rel in ALLOWED_CALLBACK_MODULES:
+                continue
+            module = project.modules[rel]
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = (f.id if isinstance(f, ast.Name)
+                        else f.attr if isinstance(f, ast.Attribute)
+                        else None)
+                if name in CALLBACK_NAMES:
+                    yield Finding(
+                        self.id, rel, node.lineno,
+                        f"`{name}` outside robust/faults.py — host "
+                        f"callbacks are restricted to the registered "
+                        f"fault-consumption sites so ordering and retrace "
+                        f"semantics stay auditable in one place")

@@ -1,0 +1,446 @@
+"""Policy-seam rules (SEAM0xx): the 10 contract assertions migrated from
+``tools/check_error_contracts.py`` (which is now a thin shim over this
+pack; see docs/ROBUSTNESS.md for the contracts themselves).
+
+Every finding also carries a ``legacy`` string — the byte-identical
+report line the pre-migration checker printed — so the shim's output and
+exit codes are unchanged.  The scan runs once per project (cached) and
+yields findings in the legacy order; each rule plugin filters its own id
+out of the shared scan.
+
+Rule map (old "point" numbers from the shim's docstring):
+
+====== ===============================================================
+SEAM001 point 1 — public drivers accept ``opts``
+SEAM002 point 2 — checked driver modules import the robust layer
+SEAM003 point 3 — ... and actually reference the health machinery
+SEAM004 point 4 — internal/rbt.py stays policy-free
+SEAM005 point 5 — speculative boundaries resolve_speculate exactly
+        once; recovery boundaries route bounded_retry + one finalize
+SEAM006 point 6 — Option.Speculate never read in a driver module
+SEAM007 point 7 — robust/abft.py policy-free and raise-free
+SEAM008 point 8 — ABFT boundaries resolve_abft exactly once
+SEAM009 point 9 — maybe_corrupt sites are literals from faults.SITES
+SEAM010 point 10 — Option.Abft never read in a driver module
+====== ===============================================================
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..model import Finding, Rule, register
+
+# ---- configuration (moved verbatim from tools/check_error_contracts.py)
+
+DRIVERS_DIR = "slate_tpu/drivers"
+
+CHECKED_MODULES = (
+    "lu.py", "cholesky.py", "band.py", "mixed.py", "qr.py",
+    "heev.py", "svd.py", "stedc.py", "hetrf.py", "inverse.py",
+    "condest.py",
+)
+
+EXEMPT = {
+    "tree_flatten", "tree_unflatten", "lower", "upper",
+    "norm1est",
+    "stedc_info",
+}
+
+HEALTH_NAMES = {"finalize", "finalize_flat", "error_policy", "HealthInfo",
+                "from_pivots", "from_result"}
+
+SPECULATIVE_BOUNDARIES = (
+    ("slate_tpu/robust/recovery.py",
+     ("gesv_with_recovery", "gels_with_recovery", "hesv_with_recovery")),
+    (f"{DRIVERS_DIR}/mixed.py", ("gesv_mixed",)),
+)
+RECOVERY_BOUNDARIES = {"gesv_with_recovery", "gels_with_recovery",
+                       "hesv_with_recovery"}
+RBT_MODULE = "slate_tpu/internal/rbt.py"
+FINALIZE_NAMES = {"finalize", "_finalize_solve"}
+
+ABFT_MODULE = "slate_tpu/robust/abft.py"
+FAULTS_MODULE = "slate_tpu/robust/faults.py"
+ABFT_BOUNDARIES = (
+    (f"{DRIVERS_DIR}/lu.py", ("_getrf",)),
+    (f"{DRIVERS_DIR}/cholesky.py", ("potrf",)),
+    (f"{DRIVERS_DIR}/blas3.py", ("gemm", "trsm")),
+    ("slate_tpu/robust/recovery.py",
+     ("gesv_with_recovery", "posv_with_recovery")),
+)
+
+# ---- AST helpers (ported) ------------------------------------------------
+
+
+def _public_functions(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+            yield node
+
+
+def _accepts_opts(fn: ast.FunctionDef) -> bool:
+    names = [a.arg for a in fn.args.args + fn.args.kwonlyargs]
+    return "opts" in names or fn.args.kwarg is not None
+
+
+def _imports_robust(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mod = node.module
+            if "robust" in mod.split("."):
+                return True
+            if mod.endswith("robust") or ".robust." in f".{mod}.":
+                return True
+        if isinstance(node, ast.Import):
+            if any("robust" in alias.name.split(".")
+                   for alias in node.names):
+                return True
+    return False
+
+
+def _references_health(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in HEALTH_NAMES:
+            return True
+        if isinstance(node, ast.Name) and node.id in HEALTH_NAMES:
+            return True
+    return False
+
+
+def _count_calls(fn: ast.FunctionDef, names: set[str]) -> int:
+    c = 0
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in names:
+                c += 1
+            elif isinstance(f, ast.Attribute) and f.attr in names:
+                c += 1
+    return c
+
+
+def _fault_sites(project) -> set[str]:
+    mod = project.module(FAULTS_MODULE)
+    if mod is None:
+        return set()
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                            ast.Name):
+            targets = [node.target.id]
+        if "SITES" in targets and node.value is not None:
+            return {c.value for c in ast.walk(node.value)
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)}
+    return set()
+
+
+def _driver_modules(project):
+    """Checked driver files in sorted-filename order (old glob order)."""
+    rels = [r for r in project.modules
+            if r.startswith(DRIVERS_DIR + "/") and r.count("/") == 2]
+    return sorted(rels)
+
+
+def _slate_modules(project):
+    """slate_tpu/**/*.py in old ``sorted(rglob)`` (path-parts) order."""
+    rels = [r for r in project.modules if r.startswith("slate_tpu/")]
+    return sorted(rels, key=lambda r: tuple(r.split("/")))
+
+
+# ---- the ordered scan ----------------------------------------------------
+
+
+def _mechanism_purity(project, rel, banned_pkgs, legacy_name, legacy_tail,
+                      rule_id, *, missing_tail, check_raise=False,
+                      raise_tail=""):
+    """Shared shape of points 4 and 7: a mechanism module must exist, not
+    import the policy layers, and (optionally) never raise."""
+    mod = project.module(rel)
+    if mod is None:
+        yield (rule_id, Finding(
+            rule_id, rel, 1, f"missing {missing_tail}",
+            legacy=f"{legacy_name}: missing {missing_tail}"))
+        return
+    for node in ast.walk(mod.tree):
+        mods = []
+        if isinstance(node, ast.ImportFrom) and node.module:
+            mods = node.module.split(".")
+        elif isinstance(node, ast.Import):
+            mods = [s for a in node.names for s in a.name.split(".")]
+        if any(p in mods for p in banned_pkgs):
+            yield (rule_id, Finding(
+                rule_id, rel, node.lineno, legacy_tail,
+                legacy=f"{legacy_name}:{node.lineno}: {legacy_tail}"))
+        if check_raise and isinstance(node, ast.Raise):
+            yield (rule_id, Finding(
+                rule_id, rel, node.lineno, raise_tail,
+                legacy=f"{legacy_name}:{node.lineno}: {raise_tail}"))
+
+
+def seam_scan(project) -> list[tuple[str, Finding]]:
+    """All seam findings, in the legacy checker's report order."""
+    if "seam_scan" in project.cache:
+        return project.cache["seam_scan"]
+    out: list[tuple[str, Finding]] = []
+    out.extend(_scan_speculation(project))
+    out.extend(_scan_abft(project))
+    out.extend(_scan_driver_contract(project))
+    project.cache["seam_scan"] = out
+    return out
+
+
+def _scan_speculation(project):
+    # point 4: rbt.py stays pure mechanism
+    yield from _mechanism_purity(
+        project, RBT_MODULE, ("options", "robust"), "internal/rbt.py",
+        "imports the options/robust layer — the butterfly mechanism must "
+        "stay policy-free (the seam is drivers/lu.py + robust/recovery.py)",
+        "SEAM004",
+        missing_tail="(the RBT mechanism module the speculative gesv "
+                     "path builds on)")
+    # point 5: boundaries resolve the knob exactly once
+    for rel, fns in SPECULATIVE_BOUNDARIES:
+        mod = project.module(rel)
+        if mod is None:
+            yield ("SEAM005", Finding(
+                "SEAM005", rel, 1, "missing speculative boundary module",
+                legacy=f"{rel}: missing speculative boundary module"))
+            continue
+        defs = {n.name: n for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef)}
+        for fname in fns:
+            fn = defs.get(fname)
+            if fn is None:
+                yield ("SEAM005", Finding(
+                    "SEAM005", rel, 1,
+                    f"speculative boundary `{fname}` not found",
+                    legacy=f"{rel}: speculative boundary "
+                           f"`{fname}` not found"))
+                continue
+            n_res = _count_calls(fn, {"resolve_speculate"})
+            if n_res != 1:
+                msg = (f"`{fname}` calls resolve_speculate {n_res}x — the "
+                       f"knob must be resolved EXACTLY once at the boundary")
+                yield ("SEAM005", Finding(
+                    "SEAM005", rel, fn.lineno, msg,
+                    legacy=f"{rel}:{fn.lineno}: `{fname}` calls "
+                           f"resolve_speculate {n_res}x — the knob must be "
+                           f"resolved EXACTLY once at the boundary"))
+            if fname in RECOVERY_BOUNDARIES:
+                if _count_calls(fn, {"bounded_retry"}) < 1:
+                    msg = (f"`{fname}` never routes through bounded_retry "
+                           f"— speculation has no escalation path")
+                    yield ("SEAM005", Finding(
+                        "SEAM005", rel, fn.lineno, msg,
+                        legacy=f"{rel}:{fn.lineno}: `{fname}` never routes "
+                               f"through bounded_retry — speculation has "
+                               f"no escalation path"))
+                n_fin = _count_calls(fn, FINALIZE_NAMES)
+                if n_fin != 1:
+                    msg = (f"`{fname}` finalizes {n_fin}x — the (result, "
+                           f"HealthInfo) pair must resolve ErrorPolicy "
+                           f"exactly once")
+                    yield ("SEAM005", Finding(
+                        "SEAM005", rel, fn.lineno, msg,
+                        legacy=f"{rel}:{fn.lineno}: `{fname}` finalizes "
+                               f"{n_fin}x — the (result, HealthInfo) pair "
+                               f"must resolve ErrorPolicy exactly once"))
+    # point 6: the raw knob never leaks into a driver module
+    for rel in _driver_modules(project):
+        mod = project.modules[rel]
+        fname = rel.rsplit("/", 1)[1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "Speculate":
+                msg = ("reads Option.Speculate directly — drivers consume "
+                       "resolve_speculate's boolean, never the raw knob")
+                yield ("SEAM006", Finding(
+                    "SEAM006", rel, node.lineno, msg,
+                    legacy=f"drivers/{fname}:{node.lineno}: reads "
+                           f"Option.Speculate directly — drivers consume "
+                           f"resolve_speculate's boolean, never the raw "
+                           f"knob"))
+
+
+def _scan_abft(project):
+    # point 7: abft.py pure mechanism — no options import, no raises
+    purity = list(_mechanism_purity(
+        project, ABFT_MODULE, ("options",), "robust/abft.py",
+        "imports the options layer — checksum verification must stay "
+        "policy-free (the seam is the driver boundary's resolve_abft)",
+        "SEAM007",
+        missing_tail="(the checksum mechanism module the ABFT layer "
+                     "builds on)",
+        check_raise=True,
+        raise_tail="raises — detection is DATA (AbftCounts folded into "
+                   "HealthInfo); policy resolution lives at the driver "
+                   "boundary"))
+    yield from purity
+    if project.module(ABFT_MODULE) is None:
+        return  # legacy short-circuit: no boundary checks without abft.py
+    # point 8: ABFT boundaries resolve the knob exactly once
+    for rel, fns in ABFT_BOUNDARIES:
+        mod = project.module(rel)
+        if mod is None:
+            yield ("SEAM008", Finding(
+                "SEAM008", rel, 1, "missing ABFT boundary module",
+                legacy=f"{rel}: missing ABFT boundary module"))
+            continue
+        defs = {n.name: n for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef)}
+        for fname in fns:
+            fn = defs.get(fname)
+            if fn is None:
+                yield ("SEAM008", Finding(
+                    "SEAM008", rel, 1, f"ABFT boundary `{fname}` not found",
+                    legacy=f"{rel}: ABFT boundary `{fname}` "
+                           f"not found"))
+                continue
+            n_res = _count_calls(fn, {"resolve_abft"})
+            if n_res != 1:
+                msg = (f"`{fname}` calls resolve_abft {n_res}x — the knob "
+                       f"must be resolved EXACTLY once at the boundary")
+                yield ("SEAM008", Finding(
+                    "SEAM008", rel, fn.lineno, msg,
+                    legacy=f"{rel}:{fn.lineno}: `{fname}` calls "
+                           f"resolve_abft {n_res}x — the knob must be "
+                           f"resolved EXACTLY once at the boundary"))
+    # point 9: every maybe_corrupt call names a site literal in SITES
+    sites = _fault_sites(project)
+    if not sites:
+        yield ("SEAM009", Finding(
+            "SEAM009", FAULTS_MODULE, 1, "SITES vocabulary not found",
+            legacy="robust/faults.py: SITES vocabulary not found"))
+    for rel in _slate_modules(project):
+        if rel == FAULTS_MODULE:
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = (f.id if isinstance(f, ast.Name)
+                    else f.attr if isinstance(f, ast.Attribute) else None)
+            if name != "maybe_corrupt":
+                continue
+            if not node.args or not (isinstance(node.args[0], ast.Constant)
+                                     and isinstance(node.args[0].value,
+                                                    str)):
+                msg = ("maybe_corrupt site is not a string literal — sites "
+                       "must be a closed, greppable vocabulary")
+                yield ("SEAM009", Finding(
+                    "SEAM009", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: maybe_corrupt site is "
+                           f"not a string literal — sites must be a "
+                           f"closed, greppable vocabulary"))
+            elif sites and node.args[0].value not in sites:
+                msg = (f"maybe_corrupt site {node.args[0].value!r} not in "
+                       f"faults.SITES")
+                yield ("SEAM009", Finding(
+                    "SEAM009", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: maybe_corrupt site "
+                           f"{node.args[0].value!r} not in faults.SITES"))
+    # point 10: the raw knob never leaks into a driver module
+    for rel in _driver_modules(project):
+        mod = project.modules[rel]
+        fname = rel.rsplit("/", 1)[1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute) and node.attr == "Abft":
+                msg = ("reads Option.Abft directly — drivers consume "
+                       "resolve_abft's boolean, never the raw knob")
+                yield ("SEAM010", Finding(
+                    "SEAM010", rel, node.lineno, msg,
+                    legacy=f"drivers/{fname}:{node.lineno}: reads "
+                           f"Option.Abft directly — drivers consume "
+                           f"resolve_abft's boolean, never the raw knob"))
+
+
+def _scan_driver_contract(project):
+    # points 1-3, interleaved per module as the legacy loop did
+    for name in CHECKED_MODULES:
+        rel = f"{DRIVERS_DIR}/{name}"
+        mod = project.module(rel)
+        if mod is None:
+            yield ("SEAM002", Finding(
+                "SEAM002", rel, 1, "missing driver module",
+                legacy=f"{name}: missing driver module"))
+            continue
+        if not _imports_robust(mod.tree):
+            msg = ("does not import the robust layer "
+                   "(health/faults/recovery) — failures are not routed "
+                   "through Option.ErrorPolicy")
+            yield ("SEAM002", Finding(
+                "SEAM002", rel, 1, msg,
+                legacy=f"{name}: does not import the robust layer "
+                       f"(health/faults/recovery) — failures are not "
+                       f"routed through Option.ErrorPolicy"))
+        elif not _references_health(mod.tree):
+            msg = ("imports the robust layer but never touches the health "
+                   "machinery (finalize/error_policy/HealthInfo) — no "
+                   "policy is resolved")
+            yield ("SEAM003", Finding(
+                "SEAM003", rel, 1, msg,
+                legacy=f"{name}: imports the robust layer but never "
+                       f"touches the health machinery "
+                       f"(finalize/error_policy/HealthInfo) — "
+                       f"no policy is resolved"))
+        for fn in _public_functions(mod.tree):
+            if fn.name in EXEMPT:
+                continue
+            if not _accepts_opts(fn):
+                msg = (f"public driver `{fn.name}` does not accept `opts` "
+                       f"— Option.ErrorPolicy cannot reach it")
+                yield ("SEAM001", Finding(
+                    "SEAM001", rel, fn.lineno, msg,
+                    legacy=f"{name}:{fn.lineno}: public driver "
+                           f"`{fn.name}` does not accept `opts` — "
+                           f"Option.ErrorPolicy cannot reach it"))
+
+
+def legacy_report(project) -> list[str]:
+    """The pre-migration checker's report lines, in its order, honoring
+    per-line suppressions (the legacy checker predates suppressions, so a
+    clean repo yields [] under both)."""
+    out = []
+    for rule_id, f in seam_scan(project):
+        mod = project.module(f.path)
+        if mod is not None and mod.suppressed(f.line, rule_id):
+            continue
+        out.append(f.legacy)
+    return out
+
+
+class _SeamRule(Rule):
+    def run(self, project):
+        for rule_id, finding in seam_scan(project):
+            if rule_id == self.id:
+                yield finding
+
+
+def _make(rule_id: str, text: str) -> None:
+    cls = type(f"Seam{rule_id[-3:]}", (_SeamRule,),
+               {"id": rule_id, "summary": text})
+    register(cls)
+
+
+_make("SEAM001", "public factor/solve drivers accept `opts` — "
+      "Option.ErrorPolicy must be routable to every entry point")
+_make("SEAM002", "checked driver modules import the robust layer "
+      "(health/faults/recovery)")
+_make("SEAM003", "checked driver modules reference the health machinery "
+      "— an import alone is not a contract")
+_make("SEAM004", "internal/rbt.py stays pure mechanism (no options/robust "
+      "imports)")
+_make("SEAM005", "speculative boundaries resolve_speculate exactly once; "
+      "recovery boundaries route bounded_retry + finalize once")
+_make("SEAM006", "no driver module reads the raw Option.Speculate knob")
+_make("SEAM007", "robust/abft.py stays pure mechanism: no options import, "
+      "no raise — detection is data")
+_make("SEAM008", "ABFT boundaries resolve_abft exactly once")
+_make("SEAM009", "maybe_corrupt sites are string literals from "
+      "faults.SITES — a closed, greppable vocabulary")
+_make("SEAM010", "no driver module reads the raw Option.Abft knob")

@@ -1,0 +1,208 @@
+"""Trace-safety rules (TRC0xx): applied ONLY to the traced function set
+computed by the reachability pass.
+
+The bug class: Python-level control flow or concretization on a traced
+value explodes at trace time (``TracerBoolConversionError``) or — worse —
+silently bakes one branch into the compiled program.  The rules flag the
+concretization points; values are tracked by the taint analysis in
+``dataflow.py``.
+
+``raise`` inside a traced body is allowed only in the *registered eager
+boundaries* — the policy-seam modules whose raises are guarded by
+``HealthInfo.is_traced()`` checks (robust/health.py, robust/recovery.py)
+or are trace-time config validation (exceptions.py, options.py).  A
+raise anywhere else in the traced set needs an inline
+``# slate-lint: disable=TRC006 -- <why this runs at trace time>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .. import dataflow, reachability
+from ..model import Finding, Rule, register
+
+#: modules whose raises are the designed eager policy seam
+EAGER_BOUNDARY_MODULES = {
+    "slate_tpu/robust/health.py",
+    "slate_tpu/robust/recovery.py",
+    "slate_tpu/exceptions.py",
+    "slate_tpu/options.py",
+}
+
+
+def _numpy_aliases(imports: dict[str, str]) -> set[str]:
+    return {name for name, dotted in imports.items()
+            if dotted == "numpy" or dotted.startswith("numpy.")}
+
+
+def _taints(project):
+    """Taint analyses for every traced function, parents before children
+    so closures inherit the enclosing function's tainted names."""
+    if "taints" in project.cache:
+        return project.cache["taints"]
+    reach = reachability.compute(project)
+    memo: dict[str, dataflow.TaintAnalysis] = {}
+
+    def get(key: str) -> dataflow.TaintAnalysis:
+        if key in memo:
+            return memo[key]
+        info = reach.functions[key]
+        inherited = frozenset()
+        if info.parent is not None and info.parent.key in reach.traced:
+            inherited = frozenset(get(info.parent.key).tainted)
+        memo[key] = dataflow.analyze(
+            info, reach.imports[info.module.rel],
+            reach.taint_all_params(info), inherited)
+        return memo[key]
+
+    for key in reach.traced:
+        if key in reach.functions:
+            get(key)
+    project.cache["taints"] = (reach, memo)
+    return project.cache["taints"]
+
+
+class _TraceRule(Rule):
+    """Shared driver: subclasses implement ``visit`` per traced node."""
+
+    def run(self, project):
+        reach, taints = _taints(project)
+        for key in sorted(taints):
+            info = reach.functions[key]
+            ta = taints[key]
+            np_aliases = _numpy_aliases(reach.imports[info.module.rel])
+            for node in reachability.own_nodes(info.node):
+                yield from self.visit(node, ta, info, np_aliases)
+
+    def visit(self, node, ta, info, np_aliases):  # pragma: no cover
+        raise NotImplementedError
+        yield
+
+    def _finding(self, node, info, message) -> Finding:
+        return Finding(self.id, info.module.rel, node.lineno, message)
+
+
+@register
+class TracedBranch(_TraceRule):
+    id = "TRC001"
+    summary = ("Python `if`/ternary/short-circuit on a traced value — "
+               "concretizes at trace time; use jnp.where / lax.cond")
+
+    def visit(self, node, ta, info, np_aliases):
+        if isinstance(node, (ast.If, ast.IfExp)) and \
+                ta.expr_tainted(node.test):
+            yield self._finding(
+                node, info,
+                f"Python branch on a traced value in `{info.qual}` — "
+                f"this concretizes the tracer (TracerBoolConversionError "
+                f"under jit); use jnp.where or lax.cond")
+
+
+@register
+class TracedLoop(_TraceRule):
+    id = "TRC002"
+    summary = ("Python `while`/`for` driven by a traced value — loop "
+               "bounds must be static; use lax.fori_loop / lax.scan / "
+               "lax.while_loop")
+
+    def visit(self, node, ta, info, np_aliases):
+        if isinstance(node, ast.While) and ta.expr_tainted(node.test):
+            yield self._finding(
+                node, info,
+                f"`while` on a traced condition in `{info.qual}` — the "
+                f"trip count cannot depend on traced data; use "
+                f"lax.while_loop")
+        elif isinstance(node, ast.For) and ta.expr_tainted(node.iter):
+            yield self._finding(
+                node, info,
+                f"`for` over a traced iterable in `{info.qual}` — "
+                f"iteration unrolls over tracer contents; use lax.scan "
+                f"or lax.fori_loop")
+
+
+@register
+class TracedAssert(_TraceRule):
+    id = "TRC003"
+    summary = ("`assert` on a traced value — stripped under -O and "
+               "concretizes the tracer; use checkify or a health check")
+
+    def visit(self, node, ta, info, np_aliases):
+        if isinstance(node, ast.Assert) and ta.expr_tainted(node.test):
+            yield self._finding(
+                node, info,
+                f"`assert` on a traced value in `{info.qual}` — "
+                f"concretizes at trace time and vanishes under -O; route "
+                f"failures through HealthInfo instead")
+
+
+@register
+class TracedConcretize(_TraceRule):
+    id = "TRC004"
+    summary = ("bool()/float()/int()/.item()/.tolist() on a traced value "
+               "— forces a host sync or fails under jit")
+
+    def visit(self, node, ta, info, np_aliases):
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in dataflow.CONCRETIZERS \
+                and any(ta.expr_tainted(a) for a in node.args):
+            yield self._finding(
+                node, info,
+                f"{f.id}() on a traced value in `{info.qual}` — "
+                f"concretization fails under jit; keep the value as an "
+                f"array or resolve it at the eager boundary")
+        elif isinstance(f, ast.Attribute) \
+                and f.attr in dataflow.CONCRETIZING_METHODS \
+                and ta.expr_tainted(f.value):
+            yield self._finding(
+                node, info,
+                f".{f.attr}() on a traced value in `{info.qual}` — "
+                f"concretization fails under jit; keep the value as an "
+                f"array or resolve it at the eager boundary")
+
+
+@register
+class NumpyOnTraced(_TraceRule):
+    id = "TRC005"
+    summary = ("host numpy applied to a traced value — silently "
+               "concretizes; use jnp (numpy on static shapes/seeds is "
+               "fine)")
+
+    def visit(self, node, ta, info, np_aliases):
+        if not isinstance(node, ast.Call):
+            return
+        f = node.func
+        base = f.value if isinstance(f, ast.Attribute) else None
+        while isinstance(base, ast.Attribute):  # np.linalg.norm chains
+            base = base.value
+        is_np = isinstance(base, ast.Name) and base.id in np_aliases
+        if is_np and (any(ta.expr_tainted(a) for a in node.args)
+                      or any(ta.expr_tainted(kw.value)
+                             for kw in node.keywords)):
+            yield self._finding(
+                node, info,
+                f"host numpy call on a traced value in `{info.qual}` — "
+                f"np.* concretizes tracers; use the jnp equivalent")
+
+
+@register
+class RaiseInTraced(_TraceRule):
+    id = "TRC006"
+    summary = ("`raise` inside a traced body outside the registered "
+               "eager boundaries — failures must flow as data "
+               "(HealthInfo / non-finites)")
+
+    def visit(self, node, ta, info, np_aliases):
+        if not isinstance(node, ast.Raise):
+            return
+        if info.module.rel in EAGER_BOUNDARY_MODULES:
+            return
+        yield self._finding(
+            node, info,
+            f"`raise` in traced function `{info.qual}` — only the "
+            f"registered eager boundaries (robust/health.py, "
+            f"robust/recovery.py, exceptions.py, options.py) may raise; "
+            f"route failures through HealthInfo, or suppress with a "
+            f"reason if this provably runs at trace time")
