@@ -31,8 +31,13 @@ overlap) and fetches one element once.
 reference repo publishes (dgemm n=10000, 4 ranks x 1 GPU, 0.712 s =
 702 GFLOP/s per GPU, ref docs/usage.md:41-42).  Set SLATE_BENCH_QUICK=1 for
 a seconds-scale smoke run of the same harness at toy sizes.
+
+``--sweep-nb`` switches to the autotuner's search space instead of the
+headline metrics: one JSON line per candidate (kernel, nb, bw) plan per
+op (slate_tpu.tune.autotune), so BENCH rounds record what the tuner saw.
 """
 
+import argparse
 import json
 import os
 import signal
@@ -325,6 +330,63 @@ def bench_svd(n, nb, iters):
     _emit(f"svd_vals_n{n}_gflops_per_chip", gflops, {"nb": nb})
 
 
+def _kernel_interpret():
+    """Fused Pallas kernels run in interpret mode off-TPU (CPU smoke)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # noqa: BLE001 — no backend at all
+        return True
+
+
+def bench_potrf_fused(n, nb, bw, iters):
+    """Fused Cholesky panel step (PERF r7): one pallas_call doing the
+    trailing update (col - left @ lead), the nb x nb tile factor, and the
+    L21 panel solve, MXU-resident.  Measures the panel seam in isolation
+    so sweeps can compare (nb, bw) plans without full-driver noise."""
+    from slate_tpu.internal.pallas_chol import chol_panel_fused
+
+    rng = np.random.default_rng(7)
+    k = nb                                  # one prior panel of history
+    base = rng.standard_normal((n, nb)).astype(np.float32)
+    top = base[:nb] @ base[:nb].T / nb + nb * np.eye(nb, dtype=np.float32)
+    target = np.concatenate([top, base[nb:]], axis=0)
+    left = (rng.standard_normal((n, k)).astype(np.float32) * 0.01)
+    lead = left[:nb].T.copy()
+    col = jnp.asarray(target + left @ lead)
+    left, lead = jnp.asarray(left), jnp.asarray(lead)
+    interp = _kernel_interpret()
+
+    def body(carry, col, left, lead):
+        upd, fac = chol_panel_fused(col * (1.0 + carry), left, lead,
+                                    bw=bw, interpret=interp)
+        return fac[0, 0] * 1e-24
+
+    # update 2*n*nb*k + tile factor nb^3/3 + panel solve (n-nb)*nb^2
+    flops = 2.0 * n * nb * k + nb**3 / 3.0 + (n - nb) * nb**2
+    gflops = _time_chain(body, jnp.float32(0.0), (col, left, lead),
+                         iters, flops)
+    _emit(f"potrf_fused_n{n}_gflops_per_chip", gflops, {"nb": nb, "bw": bw})
+
+
+def bench_geqrf_panel(m, n, iters):
+    """Pallas Householder QR panel (PERF r7): panel factor + compact-WY T
+    in one kernel.  The panel is the latency-bound piece of tall-skinny
+    geqrf, so its throughput bounds the gels MFU target."""
+    from slate_tpu.internal.pallas_qr import qr_panel_pallas
+
+    rng = np.random.default_rng(8)
+    a = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32))
+    interp = _kernel_interpret()
+
+    def body(carry, a):
+        packed, t = qr_panel_pallas(a * (1.0 + carry), interpret=interp)
+        return packed[0, 0] * 1e-24
+
+    flops = 2.0 * m * n**2            # dominant term of 2mn^2 - 2n^3/3
+    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"geqrf_panel_m{m}_n{n}_gflops_per_chip", gflops)
+
+
 QUICK_STEPS = [
     (bench_gemm, dict(n=512, nb=128, iters=4)),
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
@@ -336,6 +398,8 @@ QUICK_STEPS = [
     (bench_gels, dict(m=4096, n=256, nb=128, nrhs=16, iters=2)),
     (bench_heev, dict(n=512, nb=128, iters=2)),
     (bench_svd, dict(n=512, nb=128, iters=2)),
+    (bench_potrf_fused, dict(n=256, nb=128, bw=8, iters=2)),
+    (bench_geqrf_panel, dict(m=512, n=128, iters=2)),
 ]
 
 FULL_STEPS = [
@@ -351,6 +415,8 @@ FULL_STEPS = [
     (bench_gels, dict(m=131072, n=1024, nb=256, nrhs=64, iters=4)),
     (bench_heev, dict(n=4096, nb=256, iters=3)),
     (bench_svd, dict(n=2048, nb=256, iters=3)),
+    (bench_potrf_fused, dict(n=4096, nb=256, bw=8, iters=10)),
+    (bench_geqrf_panel, dict(m=8192, n=256, iters=10)),
 ]
 
 
@@ -366,7 +432,48 @@ def _skip_line(fn, reason):
     }), flush=True)
 
 
-def _run_isolated(steps, budget_s=None):
+# Test seam: the watchdog's hard exit.  os._exit (not sys.exit) because the
+# whole point is escaping a thread blocked inside a C++ compile that Python
+# exceptions and SIGALRM cannot reach (the BENCH r05 rc=124 stall).
+_EXIT = os._exit
+_WATCHDOG_GRACE_S = 10.0
+
+
+def _install_watchdog(steps, deadline, done, exit_fn=None):
+    """Arm a daemon thread that hard-exits 0 just past ``deadline``.
+
+    SIGALRM preemption (below) only works when the main thread is running
+    Python bytecode; the r05 rc=124 came from a metric stuck inside a
+    blocking C++ compile, where the alarm is queued but never delivered.
+    The watchdog runs on its own thread, so it fires regardless: it emits
+    a "skipped" line for every step index not yet in ``done`` (index, not
+    fn — FULL_STEPS repeats bench_gemm) and then exits 0 so the external
+    GNU ``timeout`` never gets the chance to return 124.
+
+    Returns a threading.Event; set() it to stand the watchdog down.
+    """
+    stop = threading.Event()
+    grace_deadline = deadline + _WATCHDOG_GRACE_S
+
+    def _watch():
+        while not stop.is_set():
+            remaining = grace_deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            stop.wait(min(remaining, 1.0))
+        if stop.is_set():
+            return
+        for idx, (fn, _) in enumerate(steps):
+            if idx not in done:
+                _skip_line(fn, "time budget exceeded (watchdog)")
+        (exit_fn or _EXIT)(0)
+
+    threading.Thread(target=_watch, name="bench-watchdog",
+                     daemon=True).start()
+    return stop
+
+
+def _run_isolated(steps, budget_s=None, done=None, deadline=None):
     """Run each benchmark in isolation: one flake (e.g. a remote-compile
     tunnel error) must still let every other metric emit — the r04 run lost
     heev AND svd to a single transient (VERDICT r4 weak #3).
@@ -377,17 +484,25 @@ def _run_isolated(steps, budget_s=None):
     by SIGALRM (main thread only — signals cannot interrupt other
     threads).  Either way the metric emits an explicit "skipped" JSON
     line, so the output always has one line per step and the r05 timeout
-    (rc=124, zero lines after the stall) cannot recur."""
+    (rc=124, zero lines after the stall) cannot recur.
+
+    ``done``/``deadline`` let main() share progress with the watchdog
+    thread (_install_watchdog): completed step INDICES are added to
+    ``done`` so a watchdog firing mid-run only skip-reports the metrics
+    that have not emitted yet."""
     failures = 0
     can_alarm = (budget_s and hasattr(signal, "setitimer")
                  and threading.current_thread() is threading.main_thread())
-    deadline = (time.monotonic() + budget_s * len(steps)
-                if budget_s else None)
-    for fn, kwargs in steps:
+    if deadline is None:
+        deadline = (time.monotonic() + budget_s * len(steps)
+                    if budget_s else None)
+    for idx, (fn, kwargs) in enumerate(steps):
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 _skip_line(fn, "time budget exhausted")
+                if done is not None:
+                    done.add(idx)
                 continue
         if can_alarm:
             def _on_alarm(signum, frame):
@@ -406,24 +521,87 @@ def _run_isolated(steps, budget_s=None):
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }), flush=True)
         finally:
+            if done is not None:
+                done.add(idx)
             if can_alarm:
                 signal.setitimer(signal.ITIMER_REAL, 0)
                 signal.signal(signal.SIGALRM, prev)
     return failures
 
 
-def main():
+def sweep_nb():
+    """Emit one JSON line per candidate (kernel, nb, bw) plan per op —
+    the autotuner's raw search space (slate_tpu.tune.autotune.sweep), so
+    BENCH rounds record what the tuner saw, not just the winner."""
+    from slate_tpu.tune import autotune, chip_kind
+
+    chip = chip_kind()
+    sizes = {
+        "potrf_tile": 256 if QUICK else 512,
+        "potrf_panel": 512 if QUICK else 2048,
+        "getrf_panel": 512 if QUICK else 2048,
+        "lu_select": 512 if QUICK else 2048,
+        "geqrf_panel": 512 if QUICK else 8192,
+    }
+    iters = 1 if QUICK else 3
+    from slate_tpu.tune import OPS
+    for op in OPS:
+        n = sizes[op]
+        try:
+            for plan, gflops in autotune.sweep(op, n, "float32",
+                                               iters=iters):
+                print(json.dumps({
+                    "metric": f"sweep_{op}_n{n}", "op": op, "n": n,
+                    "kernel": plan.kernel, "nb": plan.nb, "bw": plan.bw,
+                    "value": round(float(gflops), 1), "unit": "GFLOP/s",
+                    "chip": chip,
+                }), flush=True)
+        except Exception as exc:  # noqa: BLE001 — isolate, report, continue
+            print(json.dumps({
+                "metric": f"sweep_{op}_n{n}_error", "value": None,
+                "unit": "GFLOP/s", "vs_baseline": None,
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }), flush=True)
+
+
+def main(argv=()):
     """Always exits 0: per-metric failures and budget skips are REPORTED
     (their JSON lines carry "error"/"skipped"), not escalated to a
     process failure — a harness that dies with rc=1/rc=124 loses every
-    remaining metric (BENCH_r04/r05)."""
+    remaining metric (BENCH_r04/r05).
+
+    The watchdog is armed BEFORE the first device contact (_chip_peak,
+    i.e. before any compile can block), so even a stall inside the very
+    first compilation self-terminates with rc=0 and explicit skip lines
+    instead of tripping the external timeout's rc=124."""
     global PEAK, CHIP
-    PEAK, CHIP = _chip_peak()
-    _run_isolated(QUICK_STEPS if QUICK else FULL_STEPS,
-                  budget_s=BUDGET_S or None)
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sweep-nb", action="store_true",
+                        help="emit one line per candidate autotuner plan "
+                             "instead of the headline metrics")
+    args = parser.parse_args(list(argv))
+
+    steps = [] if args.sweep_nb else (QUICK_STEPS if QUICK else FULL_STEPS)
+    done, stop = set(), None
+    if BUDGET_S:
+        deadline = time.monotonic() + BUDGET_S * max(len(steps), 1)
+        stop = _install_watchdog(steps, deadline, done)
+    else:
+        deadline = None
+
+    try:
+        PEAK, CHIP = _chip_peak()
+        if args.sweep_nb:
+            sweep_nb()
+        else:
+            _run_isolated(steps, budget_s=BUDGET_S or None,
+                          done=done, deadline=deadline)
+    finally:
+        if stop is not None:
+            stop.set()
     return 0
 
 
 if __name__ == "__main__":
     import sys
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -33,7 +33,7 @@ from ..robust import faults
 from ..robust import health as _health
 from ..types import Diag, Op, Uplo
 from .blas3 import as_root_general, trsm
-from ..internal.potrf import potrf_tile
+from ..internal.potrf import potrf_panel_fused, potrf_panel_ok, potrf_tile
 from ..internal.trsm import tri_inv_lower
 from ..util.trace import annotate
 
@@ -48,6 +48,14 @@ def _potrf_dense_blocked(a, nb: int, abft: bool = False):
     block (internal/trsm.py tri_inv_lower, MAGMA-style): one MXU gemm
     instead of a per-column substitution loop measured at 675 GFLOP/s.
 
+    When the tuned plan selects it (internal/potrf.py potrf_panel_ok),
+    the whole panel step — rank-k update, diagonal factor, TRSM — runs
+    as ONE fused Pallas kernel that also emits the pre-factor panel, so
+    every ABFT rung below verifies the same quantities either way.  A
+    fault that slips into the fused gemm is caught exactly like an XLA
+    gemm fault: sum_check repairs the pre-factor panel, and the tile /
+    panel rungs then see (and repair) the stale factored element.
+
     ``abft`` verifies every step against Huang-Abraham checksums
     (robust/abft.py): the block-column gemm through additive checksums,
     the diagonal tile through its Cholesky residual, the panel through
@@ -58,11 +66,20 @@ def _potrf_dense_blocked(a, nb: int, abft: bool = False):
     for k0 in range(0, n, nb):
         k1 = min(k0 + nb, n)
         w = k1 - k0
+        # slate-lint: disable=TRC001 -- capability probe: reads only static shape/dtype/plan, never tracer data
+        fused = potrf_panel_ok(a.dtype, n - k0, w, nb)
+        fac = None
         upd = a[k0:, k0:k1]
-        if k0:
+        if fused:
+            left = (a[k0:, :k0] if k0
+                    else jnp.zeros((n - k0, 0), a.dtype))
+            lead = jnp.conj(a[k0:k1, :k0]).T
+            upd, fac = potrf_panel_fused(a[k0:, k0:k1], left, lead)
+        elif k0:
             left = a[k0:, :k0]
             lead = jnp.conj(a[k0:k1, :k0]).T
             upd = upd - left @ lead
+        if k0:
             if abft:
                 exp_r = (jnp.sum(a[k0:, k0:k1], axis=1)
                          - left @ jnp.sum(lead, axis=1))
@@ -71,15 +88,18 @@ def _potrf_dense_blocked(a, nb: int, abft: bool = False):
                 upd, ev = _abft.sum_check(upd, exp_r, exp_c, n_ctx=n,
                                           nb=nb, row0=k0, col0=k0)
                 counts = _abft.add_counts(counts, ev)
-        lkk = faults.maybe_corrupt("post_panel", potrf_tile(upd[:w]))
+        lkk = faults.maybe_corrupt(
+            "post_panel", fac[:w] if fused else potrf_tile(upd[:w]))
         if abft:
             lkk, det, cor = _abft.chol_tile_check(upd[:w], lkk, n_ctx=n)
             counts = _abft.add_counts(
                 counts, _abft.count_event(det, cor, k0 // nb, k0 // nb))
         a = a.at[k0:k1, k0:k1].set(lkk)
         if k1 < n:
-            linv = tri_inv_lower(lkk)
-            panel = upd[w:] @ jnp.conj(linv).T
+            if fused:
+                panel = fac[w:]          # in-kernel TRSM (A21 U^-1)
+            else:
+                panel = upd[w:] @ jnp.conj(tri_inv_lower(lkk)).T
             if abft:
                 # panel X solves X L^H = R; conjugate-transpose it into
                 # the canonical left product L X^H = R^H and verify via

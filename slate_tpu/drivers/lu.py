@@ -70,7 +70,11 @@ def _getrf_dense_blocked(a, nb: int, method: str, tau: float = 1.0,
     """Blocked right-looking LU, statically-shaped panels (unrolled).
 
     Panel factor delegates to XLA's native pivoted LU (the analog of the
-    reference's lapack panel kernel); the trailing row exchange touches
+    reference's lapack panel kernel); the nopiv and tournament panels
+    route through internal/getrf.py's tuned seams, which dispatch to the
+    fused Pallas panel kernels (internal/pallas_lu.py) when the plan
+    cache selects them (slate_tpu.tune, docs/TUNING.md).  The trailing
+    row exchange touches
     only the <= 2 nb displaced rows, and the U12 solve is one MXU gemm
     against the inverted unit-L11 (internal/trsm.py tri_inv_lower) —
     ref: getrf.cc:174-215 trailing task.  ``tau`` < 1 switches to

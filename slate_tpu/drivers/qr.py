@@ -28,8 +28,7 @@ from jax import lax
 from ..core.matrix import Matrix, TriangularMatrix
 from ..core.storage import TileStorage
 from ..exceptions import SlateNotPositiveDefiniteError, slate_error
-from ..internal.qr import (apply_q_left, apply_q_right,
-                           householder_panel_blocked)
+from ..internal.qr import apply_q_left, apply_q_right, geqrf_panel
 from ..options import ErrorPolicy, Option, Options, Target, resolve_target
 from ..robust import health as _health
 from ..types import Op, Side, Uplo, is_complex
@@ -112,7 +111,7 @@ def _geqrf_dense_blocked(a, nb: int):
         k1 = min(k0 + nb, r)
         w = k1 - k0
         panel = a[k0:, k0:k1]
-        packed, T = householder_panel_blocked(panel)
+        packed, T = geqrf_panel(panel)   # tuned: Pallas panel or XLA
         a = a.at[k0:, k0:k1].set(packed)
         if k1 < n:
             trail = apply_q_left(packed, T, a[k0:, k1:], conj_trans=True)

@@ -39,14 +39,38 @@ def panel_lu(panel):
     return lu, perm
 
 
+def _nopiv_fused_ok(dtype, w: int, nb: int) -> bool:
+    """True when the tuned plan routes this no-pivot panel through the
+    fused Pallas kernel (internal/pallas_lu.py lu_panel_fused): f32,
+    MXU-aligned nb small enough for the [nb, nb] U^-1 scratch."""
+    if not (dtype == jnp.float32 and w >= nb
+            and nb % 128 == 0 and 128 <= nb <= 512):
+        return False
+    from ..tune import resolve_plan
+    return resolve_plan("getrf_panel", w, "float32").kernel == "pallas"
+
+
 def panel_lu_nopiv(panel):
     """No-pivot LU of a panel [W, nb] (ref: Tile_getrf_nopiv.hh).
 
-    Square top block factored unpivoted; rows below are one MXU gemm
-    against the inverted U (tri_inv_upper) instead of a per-column
-    substitution loop.
+    Routed through the tuned plan for ("getrf_panel", W): the fused
+    Pallas panel (tile factor + per-row-tile TRSM in one pallas_call)
+    when the plan says so, else the XLA composition — square top block
+    factored unpivoted, rows below one MXU gemm against the inverted U
+    (tri_inv_upper) instead of a per-column substitution loop.
     """
     nb = panel.shape[1]
+    # slate-lint: disable=TRC001 -- capability probe: reads only static shape/dtype/plan, never tracer data
+    if _nopiv_fused_ok(panel.dtype, panel.shape[0], nb):
+        from ..tune import resolve_plan
+        from .pallas_lu import lu_panel_fused
+        from .potrf import _interpret
+        w = panel.shape[0]
+        plan = resolve_plan("getrf_panel", w, "float32")
+        wp = -(-w // nb) * nb
+        pp = jnp.pad(panel, ((0, wp - w), (0, 0))) if wp != w else panel
+        lu = lu_panel_fused(pp, bw=plan.bw, interpret=_interpret())[:w]
+        return lu, jnp.arange(w)
     top = panel[:nb]
     lu_top = _lu_nopiv_square(top)
     u = jnp.triu(lu_top)
@@ -137,12 +161,14 @@ def panel_lu_threshold(panel, tau):
 
 def _lu_select_ok(blocks, nb: int) -> bool:
     """Route tournament pivot selection through the Pallas kernel
-    (internal/pallas_lu.py) — opt-in via SLATE_PALLAS=1 like the chol
-    tile kernel: on current hardware it ties, not beats, the batched XLA
-    LU (docs/PERF.md), but stays available as the selection seam."""
-    from .potrf import _pallas_ok
+    (internal/pallas_lu.py) when the tuned plan for ("lu_select", W)
+    says so.  The old direct SLATE_PALLAS=1 gate is deprecated — the
+    tune resolver honors the env var for one release as a force
+    override (docs/TUNING.md)."""
+    from ..tune import resolve_plan
     W = blocks.shape[1]
-    return (_pallas_ok() and blocks.dtype == jnp.float32
+    return (resolve_plan("lu_select", W, "float32").kernel == "pallas"
+            and blocks.dtype == jnp.float32
             and nb % 128 == 0 and W % 128 == 0 and W <= 4096)
 
 
@@ -181,8 +207,12 @@ def panel_lu_tournament(panel, block_rows: int, arity: int = 2):
     def keep_best(blocks, idx):
         # slate-lint: disable=TRC001 -- capability probe: reads only static shape/dtype/env, never tracer data
         if _lu_select_ok(blocks, nb):
+            from ..tune import resolve_plan
             from .pallas_lu import lu_select_pallas
-            take = jax.vmap(lu_select_pallas)(blocks)
+            from .potrf import _interpret
+            bw = resolve_plan("lu_select", blocks.shape[1], "float32").bw
+            take = jax.vmap(lambda b: lu_select_pallas(
+                b, bw=bw, interpret=_interpret()))(blocks)
         else:
             _, _, pb = jax.vmap(lax.linalg.lu)(blocks)
             take = pb[:, :nb]

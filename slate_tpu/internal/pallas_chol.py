@@ -17,6 +17,18 @@ slicing), scalars come from mask+reduce, and the inter-panel trailing
 update is a single MXU dot P^T P.  The caller transposes U once to
 return the conventional lower L.
 
+Fused panel variant (chol_panel_fused): one pallas_call grid performs
+the whole left-looking panel step — the rank-k update from the already
+factored block row, the diagonal-tile factorization, and the TRSM that
+forms L21 — without the panel ever leaving VMEM between stages.  Grid
+(Mt, Kc) walks row tiles (major) x K chunks (minor, auto double-buffered
+HBM->VMEM by the BlockSpec pipeline); an accumulator scratch carries the
+updated tile across K chunks, and a second scratch carries U^-1 from the
+diagonal tile (row tile 0) to every trailing row tile, whose TRSM is
+then a single MXU gemm A21 U^-1 (pallas_tri.upper_tri_inv).  Both the
+pre-factor update (for the ABFT checksum rungs) and the factored panel
+are emitted.
+
 Real f32 only; complex/f64 tiles use the XLA fallback (potrf_tile).
 """
 
@@ -30,19 +42,22 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_tri import upper_tri_inv
+
 _HI = lax.Precision.HIGHEST
 
 
-def _chol_kernel(a_ref, o_ref, *, bw: int):
-    n = a_ref.shape[0]
-    dt = a_ref.dtype
+def _chol_factor_in_place(o_ref, *, bw: int):
+    """Factor the SPD tile held in ``o_ref`` into its UPPER factor U
+    (A = U^T U, lower triangle exactly zero), in bw-row panels."""
+    n = o_ref.shape[0]
+    dt = o_ref.dtype
     rows = lax.broadcasted_iota(jnp.int32, (n, n), 0)
     pr = lax.broadcasted_iota(jnp.int32, (bw, n), 0)
     cn = lax.broadcasted_iota(jnp.int32, (1, n), 1)
     br = lax.broadcasted_iota(jnp.int32, (bw, bw), 0)
     bc = lax.broadcasted_iota(jnp.int32, (bw, bw), 1)
     bc1 = lax.broadcasted_iota(jnp.int32, (1, bw), 1)
-    o_ref[:] = a_ref[:]
 
     def block_step(b, _):
         j0 = b * bw
@@ -82,6 +97,88 @@ def _chol_kernel(a_ref, o_ref, *, bw: int):
         return 0
 
     lax.fori_loop(0, n // bw, block_step, 0)
+
+
+def _chol_kernel(a_ref, o_ref, *, bw: int):
+    o_ref[:] = a_ref[:]
+    _chol_factor_in_place(o_ref, bw=bw)
+
+
+def _chol_panel_kernel(col_ref, left_ref, lead_ref, upd_ref, fac_ref,
+                       acc_ref, uinv_ref, *, bw: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kc = pl.num_programs(1)
+    nb = col_ref.shape[0]
+    dt = col_ref.dtype
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = col_ref[:]
+
+    # left-looking rank-k chunk: acc -= A[i-tile, chunk] @ lead[chunk]
+    acc_ref[:] = acc_ref[:] - jnp.dot(left_ref[:], lead_ref[:],
+                                      preferred_element_type=dt,
+                                      precision=_HI)
+
+    @pl.when(j == kc - 1)
+    def _finish():
+        upd_ref[:] = acc_ref[:]              # pre-factor tile (ABFT rungs)
+
+        @pl.when(i == 0)
+        def _factor():
+            _chol_factor_in_place(acc_ref, bw=bw)
+            u = acc_ref[:]
+            eye = (lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+                   == lax.broadcasted_iota(jnp.int32, (nb, nb), 1))
+            # L00 = U^T via one-hot MXU contraction (no transpose op)
+            fac_ref[:] = lax.dot_general(u, eye.astype(dt),
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=dt,
+                                         precision=_HI)
+            uinv_ref[:] = upper_tri_inv(u)
+
+        @pl.when(i != 0)
+        def _trsm():
+            # L21 solves L21 L00^T = A21, i.e. L21 = A21 U^-1 (U = L00^T)
+            fac_ref[:] = jnp.dot(acc_ref[:], uinv_ref[:],
+                                 preferred_element_type=dt, precision=_HI)
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "interpret"))
+def chol_panel_fused(col, left, lead, bw: int = 8, interpret: bool = False):
+    """Fused left-looking Cholesky panel step.
+
+    col:  [M, nb] trailing block column A[k0:, k0:k0+nb]
+    left: [M, K]  factored block row A[k0:, :k0] (K == 0 on panel 0)
+    lead: [K, nb] conj(A[k0:k0+nb, :k0])^T
+
+    Returns (upd, fac): ``upd`` = col - left @ lead, the pre-factor panel
+    the ABFT checksum rungs verify; ``fac`` = [L00; L21], the factored
+    panel.  Caller guarantees f32, M % nb == 0, nb % bw == 0, M >= nb.
+    """
+    m, nb = col.shape
+    k = left.shape[1]
+    kb = nb
+    kp = max(kb, -(-k // kb) * kb)
+    if k != kp:                              # pad K chunks with zeros
+        left = jnp.pad(left, ((0, 0), (0, kp - k)))
+        lead = jnp.pad(lead, ((0, kp - k), (0, 0)))
+    upd, fac = pl.pallas_call(
+        functools.partial(_chol_panel_kernel, bw=bw),
+        grid=(m // nb, kp // kb),
+        in_specs=[pl.BlockSpec((nb, nb), lambda i, j: (i, 0)),
+                  pl.BlockSpec((nb, kb), lambda i, j: (i, j)),
+                  pl.BlockSpec((kb, nb), lambda i, j: (j, 0))],
+        out_specs=[pl.BlockSpec((nb, nb), lambda i, j: (i, 0)),
+                   pl.BlockSpec((nb, nb), lambda i, j: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((m, nb), col.dtype),
+                   jax.ShapeDtypeStruct((m, nb), col.dtype)],
+        scratch_shapes=[pltpu.VMEM((nb, nb), col.dtype),
+                        pltpu.VMEM((nb, nb), col.dtype)],
+        interpret=interpret,
+    )(col, left, lead)
+    return upd, fac
 
 
 @functools.partial(jax.jit, static_argnames=("bw", "interpret"))

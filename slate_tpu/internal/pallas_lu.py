@@ -16,6 +16,15 @@ exactly lax.linalg.lu's perm[:nb] for the same chunk (up to argmax tie
 order).  Round 1 of the tournament needs nothing else: the candidate
 values it forwards are the ORIGINAL rows, gathered by these indices.
 
+Fused panel variant (lu_panel_fused): the unpivoted panel factor plus
+the TRSM that scales every row tile below it, one pallas_call grid over
+[nb, nb] row tiles.  Tile 0 is factored in place in bw-row slabs (panel
+rows eliminate against themselves; the rows below the slab inside the
+tile get a block solve against the slab's Ub^-1 plus one MXU trailing
+update), then the full-tile U^-1 (pallas_tri.upper_tri_inv) rides a
+scratch to the remaining tiles, whose TRSM is one gemm each — matching
+getrf.panel_lu_nopiv's semantics (packed L\\U, unit lower implied).
+
 Real f32 only; the XLA LU remains the fallback (and the test oracle).
 """
 
@@ -28,6 +37,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_tri import upper_tri_inv
 
 _HI = lax.Precision.HIGHEST
 
@@ -111,6 +122,97 @@ def _lu_select_kernel(at_ref, mask_ref, piv_ref, ws_ref, lbuf_ref,
         return allowed
 
     lax.fori_loop(0, nb // bw, slab_step, allowed0)
+
+
+def _lu_factor_in_place(o_ref, *, bw: int):
+    """Unpivoted LU of the square [nb, nb] tile in ``o_ref``, in place:
+    packed L\\U (multipliers strictly below the diagonal, unit lower
+    implied), processed in bw-row slabs."""
+    nb = o_ref.shape[0]
+    dt = o_ref.dtype
+    lane = lax.broadcasted_iota(jnp.int32, (1, nb), 1)
+    pr = lax.broadcasted_iota(jnp.int32, (bw, nb), 0)
+    pc = lax.broadcasted_iota(jnp.int32, (bw, nb), 1)
+    slr = lax.broadcasted_iota(jnp.int32, (bw, 1), 0)
+    rows = lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+
+    def slab_step(b, _):
+        j0 = b * bw
+        P = o_ref[pl.ds(j0, bw), :]                  # [bw, nb]
+
+        def col_step(i, P):
+            j = j0 + i
+            prow = jnp.sum(jnp.where(pr == i, P, 0), axis=0, keepdims=True)
+            piv = jnp.sum(jnp.where(lane == j, prow, 0))
+            safe = jnp.where(piv == 0, 1.0, piv)
+            cpan = jnp.sum(jnp.where(pc == j, P, 0), axis=1,
+                           keepdims=True)            # [bw, 1] column j
+            l = jnp.where(slr > i, cpan / safe, 0.0)
+            urow = jnp.where(lane > j, prow, 0.0)
+            P = jnp.where(pr > i, P - l * urow, P)
+            return jnp.where((pr > i) & (pc == j), l, P)
+
+        P = lax.fori_loop(0, bw, col_step, P)
+        o_ref[pl.ds(j0, bw), :] = P
+        # rows below the slab (within the tile): block solve + trailing
+        A = o_ref[:]
+        selT = (lax.broadcasted_iota(jnp.int32, (nb, bw), 0)
+                == j0 + lax.broadcasted_iota(jnp.int32, (nb, bw), 1))
+        sel = (j0 + lax.broadcasted_iota(jnp.int32, (bw, nb), 0)
+               == lax.broadcasted_iota(jnp.int32, (bw, nb), 1))
+        C = jnp.dot(A, selT.astype(dt), preferred_element_type=dt,
+                    precision=_HI)                   # [nb, bw] slab cols
+        D = jnp.dot(P, selT.astype(dt), preferred_element_type=dt,
+                    precision=_HI)                   # [bw, bw] diag block
+        l21 = jnp.dot(C, upper_tri_inv(D), preferred_element_type=dt,
+                      precision=_HI)                 # [nb, bw]
+        u12 = jnp.where(lane >= j0 + bw, P, 0.0)     # [bw, nb] slab U rows
+        upd = jnp.dot(l21, u12, preferred_element_type=dt, precision=_HI)
+        scat = jnp.dot(l21, sel.astype(dt), preferred_element_type=dt,
+                       precision=_HI)                # l21 into slab lanes
+        below = rows >= j0 + bw
+        inblk = (cols >= j0) & (cols < j0 + bw)
+        o_ref[:] = jnp.where(below, jnp.where(inblk, scat, A - upd), A)
+        return 0
+
+    lax.fori_loop(0, nb // bw, slab_step, 0)
+
+
+def _lu_panel_kernel(p_ref, o_ref, uinv_ref, *, bw: int):
+    i = pl.program_id(0)
+    nb = p_ref.shape[0]
+    dt = p_ref.dtype
+
+    @pl.when(i == 0)
+    def _top():
+        o_ref[:] = p_ref[:]
+        _lu_factor_in_place(o_ref, bw=bw)
+        uinv_ref[:] = upper_tri_inv(o_ref[:])        # triu of packed tile
+
+    @pl.when(i != 0)
+    def _body():
+        o_ref[:] = jnp.dot(p_ref[:], uinv_ref[:], preferred_element_type=dt,
+                           precision=_HI)            # L21 = A21 U^-1
+
+
+@functools.partial(jax.jit, static_argnames=("bw", "interpret"))
+def lu_panel_fused(panel, bw: int = 8, interpret: bool = False):
+    """Fused unpivoted LU panel: packed L\\U of [W, nb], W % nb == 0.
+
+    Row tile 0 gets the in-place tile factor; every tile below it is one
+    MXU gemm against the broadcast U^-1.  Same contract as
+    getrf.panel_lu_nopiv's value output (unit lower implied)."""
+    w, nb = panel.shape
+    return pl.pallas_call(
+        functools.partial(_lu_panel_kernel, bw=bw),
+        grid=(w // nb,),
+        in_specs=[pl.BlockSpec((nb, nb), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((nb, nb), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((w, nb), panel.dtype),
+        scratch_shapes=[pltpu.VMEM((nb, nb), panel.dtype)],
+        interpret=interpret,
+    )(panel)
 
 
 @functools.partial(jax.jit, static_argnames=("bw", "interpret"))

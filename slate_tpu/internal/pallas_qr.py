@@ -1,0 +1,98 @@
+"""Pallas TPU kernel: Householder QR panel + compact-WY T, VMEM-resident.
+
+geqrf's panel step previously stitched XLA-level tile ops (qr.py
+householder_panel: one dynamic-slice rank-1 update per column, each a
+round trip through HBM for the whole [mm, w] panel).  This kernel keeps
+the panel AND the growing T triangle in VMEM for all w columns: column
+extraction is mask+reduce (no lane slicing), the trailing update and the
+T recursion are MXU dots, and the output is byte-compatible with
+(householder_panel, build_t) — R in/above the diagonal, Householder
+vectors below (unit diagonal implied), T the larft Forward/Columnwise
+triangle with tau on its diagonal.  Q = I - V T V^T.
+
+The larfg scalar math mirrors qr.py _larfg exactly (beta =
+-copysign(mu, alpha); dead columns with mu == 0 get tau = 0 and keep
+their column), so parity tests compare against the XLA panel directly.
+
+Real f32 only, mm >= w; other panels use the XLA path (qr.geqrf_panel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_HI = lax.Precision.HIGHEST
+
+
+def _qr_panel_kernel(a_ref, p_ref, t_ref):
+    mm, w = a_ref.shape
+    dt = a_ref.dtype
+    rows = lax.broadcasted_iota(jnp.int32, (mm, w), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (mm, w), 1)
+    rc = lax.broadcasted_iota(jnp.int32, (mm, 1), 0)
+    cn = lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    tc = lax.broadcasted_iota(jnp.int32, (w, w), 1)
+    trc = lax.broadcasted_iota(jnp.int32, (w, 1), 0)
+    p_ref[:] = a_ref[:]
+    t_ref[:] = jnp.zeros((w, w), dt)
+
+    def col_step(j, _):
+        A = p_ref[:]
+        colj = jnp.sum(jnp.where(cols == j, A, 0), axis=1, keepdims=True)
+        alpha = jnp.sum(jnp.where(rc == j, colj, 0))
+        x = jnp.where(rc > j, colj, 0.0)
+        mu = jnp.sqrt(alpha * alpha + jnp.sum(x * x))
+        live = mu > 0
+        beta = jnp.where(alpha >= 0, -mu, mu)
+        sb = jnp.where(live, beta, 1.0)
+        tau = jnp.where(live, (sb - alpha) / sb, 0.0)
+        scale = 1.0 / jnp.where(live, alpha - sb, 1.0)
+        v = jnp.where(rc == j, 1.0, x * scale)       # [mm, 1], v[:j] = 0
+        v = jnp.where(rc < j, 0.0, v)
+        # trailing update: A[:, j+1:] -= tau v (v^T A)
+        wrow = lax.dot_general(v, A, (((0,), (0,)), ((), ())),
+                               preferred_element_type=dt, precision=_HI)
+        wrow = jnp.where(cn > j, wrow, 0.0)          # [1, w]
+        A = A - tau * v * wrow
+        # write column j: R above+diag(beta), v strictly below
+        newc = jnp.where(rc == j, beta, jnp.where(rc < j, colj, x * scale))
+        newc = jnp.where(live, newc, colj)           # mu==0: leave column
+        A = jnp.where(cols == j, newc, A)
+        p_ref[:] = A
+        # T column j: -tau T (V^T v), diag tau (larft recursion)
+        V = jnp.where((rows > cols) & (cols < j), A, 0.0)
+        V = V + jnp.where((rows == cols) & (cols < j), 1.0, 0.0)
+        g = lax.dot_general(V, v, (((0,), (0,)), ((), ())),
+                            preferred_element_type=dt, precision=_HI)
+        tcol = -tau * jnp.dot(t_ref[:], g, preferred_element_type=dt,
+                              precision=_HI)         # [w, 1]
+        tcol = jnp.where(trc == j, tau, jnp.where(trc < j, tcol, 0.0))
+        t_ref[:] = jnp.where(tc == j, tcol, t_ref[:])
+        return 0
+
+    lax.fori_loop(0, w, col_step, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def qr_panel_pallas(a, interpret: bool = False):
+    """Packed Householder panel + T of ``a`` [mm, w], mm >= w.
+
+    Returns (packed, T) with householder_panel's packing and build_t's
+    T — drop-in for householder_panel_blocked on f32 panels."""
+    mm, w = a.shape
+    packed, t = pl.pallas_call(
+        _qr_panel_kernel,
+        out_shape=[jax.ShapeDtypeStruct((mm, w), a.dtype),
+                   jax.ShapeDtypeStruct((w, w), a.dtype)],
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)],
+        interpret=interpret,
+    )(a)
+    return packed, t

@@ -1,47 +1,84 @@
-"""internal::potrf — diagonal-tile Cholesky factor.
+"""internal::potrf — diagonal-tile Cholesky factor + fused panel seam.
 
 Analog of the reference's internal_potrf.cc:132 (lapack::potrf on the
 diagonal tile, host or device).  The reference delegates the tile factor
 to vendor LAPACK; on TPU the vendor seam (XLA's Cholesky) runs a
 per-column While loop — 2.07 ms per 512 f32 tile (docs/ceiling.jsonl).
-A VMEM-resident Pallas kernel (internal/pallas_chol.py) exists but
-measures the same per-column latency on this chip generation
-(docs/PERF.md), so XLA remains the default; set SLATE_PALLAS=1 to route
-real-TPU f32 tiles through the Pallas kernel instead.
+
+Kernel choice is now a TUNED decision: both the single-tile factor
+(potrf_tile) and the fused panel step (potrf_panel_fused: rank-k update
++ tile factor + TRSM in one pallas_call — internal/pallas_chol.py)
+consult slate_tpu.tune.resolve_plan at trace time, keyed by
+(op, n, dtype, chip).  Shipped plans default to XLA everywhere; run
+``python -m slate_tpu.tune`` on a new chip (docs/TUNING.md).
+
+The old ``SLATE_PALLAS=1`` env gate this module used to read directly
+is DEPRECATED: the tune resolver still honors it for one release as a
+force-on/force-off override of the cached plan.
 """
 
 from __future__ import annotations
 
-import os
+import functools
 
 import jax
 import jax.numpy as jnp
 
-_PALLAS_TPU = None
+from ..tune import resolve_plan
 
 
-def _pallas_ok() -> bool:
-    global _PALLAS_TPU
-    if _PALLAS_TPU is None:
-        # opt-in: at bench shapes the kernel currently only ties XLA's
-        # per-column cost (4.4 us/col vs 4.0 — docs/PERF.md), so the
-        # proven XLA path stays the default
-        if os.environ.get("SLATE_PALLAS") != "1":
-            _PALLAS_TPU = False
-        else:
-            try:
-                d = jax.devices()[0]
-                _PALLAS_TPU = "tpu" in (d.platform + d.device_kind).lower()
-            except Exception:  # noqa: BLE001 — no backend: stay on XLA
-                _PALLAS_TPU = False
-    return _PALLAS_TPU
+@functools.lru_cache(maxsize=None)
+def _interpret() -> bool:
+    """Pallas plans run interpret=True off-TPU (same results, CPU-traced)
+    so tuned paths stay testable everywhere."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # noqa: BLE001 — no backend: interpret
+        return True
+
+
+def _tile_plan_ok(dtype, n: int) -> bool:
+    if not (dtype == jnp.float32 and n % 128 == 0 and 128 <= n <= 1024):
+        return False
+    return resolve_plan("potrf_tile", n, "float32").kernel == "pallas"
 
 
 def potrf_tile(a):
-    """Factor one Hermitian positive-definite tile: returns lower L."""
+    """Factor one Hermitian positive-definite tile: returns lower L.
+
+    Routed through the tuned plan for ("potrf_tile", n): the Pallas
+    VMEM-resident kernel when the plan says so (f32, 128 <= n <= 1024,
+    n % 128 == 0), XLA's Cholesky otherwise."""
     n = a.shape[-1]
-    if (a.ndim == 2 and a.dtype == jnp.float32 and n % 128 == 0
-            and 128 <= n <= 1024 and _pallas_ok()):
+    if a.ndim == 2 and _tile_plan_ok(a.dtype, n):
         from .pallas_chol import chol_tile_pallas
-        return chol_tile_pallas(a)
+        plan = resolve_plan("potrf_tile", n, "float32")
+        return chol_tile_pallas(a, bw=plan.bw, interpret=_interpret())
     return jnp.linalg.cholesky(a)
+
+
+def potrf_panel_ok(dtype, m: int, w: int, nb: int) -> bool:
+    """True when the fused Pallas panel step serves this panel: tuned
+    plan says pallas, f32, full-width panel, MXU-aligned nb that fits
+    VMEM (the [nb, nb] accumulator + U^-1 scratches cap nb at 512)."""
+    if not (dtype == jnp.float32 and w == nb and m >= nb
+            and nb % 128 == 0 and 128 <= nb <= 512):
+        return False
+    return resolve_plan("potrf_panel", m, "float32").kernel == "pallas"
+
+
+def potrf_panel_fused(col, left, lead):
+    """Fused left-looking panel step (see pallas_chol.chol_panel_fused):
+    returns (upd, fac) = (pre-factor panel for the ABFT rungs,
+    [L00; L21]).  Caller gates with potrf_panel_ok; ragged row counts
+    are zero-padded to a tile multiple here and sliced back."""
+    from .pallas_chol import chol_panel_fused
+    m, nb = col.shape
+    plan = resolve_plan("potrf_panel", m, "float32")
+    mp = -(-m // nb) * nb
+    if mp != m:                       # zero rows factor to zero L21 rows
+        col = jnp.pad(col, ((0, mp - m), (0, 0)))
+        left = jnp.pad(left, ((0, mp - m), (0, 0)))
+    upd, fac = chol_panel_fused(col, left, lead, bw=plan.bw,
+                                interpret=_interpret())
+    return upd[:m], fac[:m]

@@ -161,6 +161,34 @@ def householder_panel_blocked(a, base_w: int = 32):
     return _householder_blocked_rec(a, base_w)
 
 
+def _qr_panel_ok(dtype, mm: int, w: int) -> bool:
+    """True when the tuned plan routes this panel through the Pallas
+    Householder kernel (internal/pallas_qr.py): real f32, MXU-aligned
+    width, and the whole [mm, w] panel + its T triangle resident in
+    VMEM (~4 MB per panel copy caps mm * w at 2^20)."""
+    if not (dtype == jnp.float32 and mm >= w and mm % 8 == 0
+            and w % 128 == 0 and 128 <= w <= 512 and mm * w <= 2 ** 20):
+        return False
+    from ..tune import resolve_plan
+    return resolve_plan("geqrf_panel", mm, "float32").kernel == "pallas"
+
+
+def geqrf_panel(a, base_w: int = 32):
+    """Tuned panel seam for geqrf/gels and the mesh QR panel step.
+
+    Routes through the plan for ("geqrf_panel", mm): the VMEM-resident
+    Pallas Householder panel (qr_panel_pallas — panel + compact-WY T in
+    one kernel) when the plan selects it, else
+    :func:`householder_panel_blocked`.  Returns (packed, T)."""
+    mm, w = a.shape
+    # slate-lint: disable=TRC001 -- capability probe: reads only static shape/dtype/plan, never tracer data
+    if _qr_panel_ok(a.dtype, mm, w):
+        from .pallas_qr import qr_panel_pallas
+        from .potrf import _interpret
+        return qr_panel_pallas(a, interpret=_interpret())
+    return householder_panel_blocked(a, base_w)
+
+
 def _householder_blocked_rec(a, base_w: int = 32):
     """The scan-based recursive panel (see householder_panel_blocked)."""
     mm, w = a.shape

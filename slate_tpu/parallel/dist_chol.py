@@ -27,6 +27,10 @@ not O(Nt), and n=50k/nb=256 (Nt≈196) compiles like Nt=16 does.
 
 Only Uplo.Lower is implemented here; the driver maps Upper problems onto it
 (ref: potrf.cc handles Upper by conjugate-transposing views the same way).
+
+The diagonal-tile factor routes through internal/potrf.py potrf_tile,
+whose kernel choice (XLA Cholesky vs the VMEM-resident Pallas tile) now
+comes from the autotuner plan cache (slate_tpu.tune, docs/TUNING.md).
 """
 
 from __future__ import annotations

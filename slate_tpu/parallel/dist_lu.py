@@ -31,6 +31,11 @@ The permutation is tracked as a full row-permutation vector ``perm`` with
 ``A[perm] == L @ U`` (identical semantics to composing the reference's
 Pivot lists).  Square matrices only (gesv path); ragged last tiles handled
 by identity-augmenting the pad block of the final panel.
+
+The replicated panel factor routes through internal/getrf.py's seams
+(panel_lu_nopiv / panel_lu_tournament), whose kernel choice — fused
+Pallas panel, Pallas pivot selection, or XLA — comes from the autotuner
+plan cache (slate_tpu.tune, docs/TUNING.md).
 """
 
 from __future__ import annotations

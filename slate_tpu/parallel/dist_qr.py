@@ -38,8 +38,8 @@ from jax.sharding import PartitionSpec as P
 from ..comm.collectives import bcast_along
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import pvary, shard_map_unchecked
-from ..internal.qr import (build_t, householder_panel,
-                           householder_panel_blocked, unit_lower)
+from ..internal.qr import (build_t, geqrf_panel, householder_panel,
+                           unit_lower)
 
 
 def _panel_tables(k: int, Mt: int, m: int, nb: int, p: int):
@@ -152,7 +152,7 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
                         jnp.zeros_like(pan))
         pan = jnp.roll(pan, -skip, axis=0)
         slab = pan.reshape(mtl * nb, nb)
-        packed, Tr = householder_panel_blocked(slab)
+        packed, Tr = geqrf_panel(slab)   # tuned: Pallas panel or XLA
         # only the owner column's panel is real; share it across the row
         packed = bcast_along(jnp.where(c == ck, packed,
                                        jnp.zeros_like(packed)), ck, AXIS_Q)

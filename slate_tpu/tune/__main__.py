@@ -1,0 +1,52 @@
+"""CLI: ``python -m slate_tpu.tune [--op OP ...] [--n N ...]``.
+
+Measures every candidate plan for the requested (op, n) grid, prints
+one JSON line per candidate, and persists the winners to the plan
+cache (unless --dry-run).  Run once per new chip kind."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import autotune, plans
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m slate_tpu.tune")
+    ap.add_argument("--op", action="append", choices=plans.OPS,
+                    help="op(s) to tune (default: all)")
+    ap.add_argument("--n", action="append", type=int,
+                    help="problem size(s) (default: 256 512 1024)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--dry-run", action="store_true",
+                    help="measure + print, do not persist")
+    args = ap.parse_args(argv)
+    ops = args.op or list(plans.OPS)
+    ns = args.n or [256, 512, 1024]
+    chip = plans.chip_kind()
+    for op in ops:
+        for n in ns:
+            best_plan, best_gf = None, -1.0
+            for plan, gf in autotune.sweep(op, n, args.dtype,
+                                           iters=args.iters):
+                print(json.dumps({"op": op, "n": n, "chip": chip,
+                                  "kernel": plan.kernel, "nb": plan.nb,
+                                  "bw": plan.bw,
+                                  "gflops": round(gf, 3)}))
+                if gf > best_gf:
+                    best_plan, best_gf = plan, gf
+            if not args.dry_run:
+                plans.record_plan(op, n, args.dtype, best_plan,
+                                  gflops=best_gf)
+            print(json.dumps({"op": op, "n": n, "chip": chip,
+                              "winner": best_plan.kernel,
+                              "nb": best_plan.nb, "bw": best_plan.bw,
+                              "persisted": not args.dry_run}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,169 @@
+"""Autotuner: measure candidate (kernel, nb, bw) plans, persist winners.
+
+One measurement = build a representative problem for the op at size n,
+jit the candidate's code path, warm it up (compile excluded), then take
+the best of ``iters`` timed runs.  Winners go to the plan cache via
+plans.record_plan; dispatch seams read them back with resolve_plan.
+
+Off-TPU the Pallas candidates run in interpret mode — functionally
+identical, uselessly slow — so tuning there just confirms the XLA
+default.  Re-tune on a new chip with ``python -m slate_tpu.tune``
+(docs/TUNING.md).
+"""
+
+from __future__ import annotations
+
+import time
+
+from .plans import OPS, TilePlan, record_plan
+
+CANDIDATE_NB = (128, 256, 512)
+CANDIDATE_BW = (8, 16)
+_SEED = 0
+
+
+def _interpret() -> bool:
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def candidates(op: str, n: int, dtype: str = "float32") -> list[TilePlan]:
+    """The search space for one (op, n, dtype): always the XLA fallback,
+    plus every shape-legal Pallas (nb, bw) pair."""
+    if op not in OPS:
+        raise ValueError(f"unknown op {op!r} (known: {OPS})")
+    plans = [TilePlan("xla", min(n, 512), 8)]
+    if dtype != "float32":
+        return plans                  # pallas kernels are f32-only
+    if op in ("potrf_tile", "lu_select"):
+        nbs = [n] if n % 128 == 0 and 128 <= n <= 1024 else []
+    else:
+        nbs = [nb for nb in CANDIDATE_NB if nb <= n and n % nb == 0]
+    for nb in nbs:
+        if op == "geqrf_panel":       # no bw knob in the QR kernel
+            plans.append(TilePlan("pallas", nb, 8))
+            continue
+        plans.extend(TilePlan("pallas", nb, bw) for bw in CANDIDATE_BW
+                     if nb % bw == 0)
+    return plans
+
+
+def _problem(op: str, plan: TilePlan, n: int):
+    """Returns (thunk, flops): a zero-arg jitted candidate runner and the
+    nominal flop count it performs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..internal import getrf, qr, trsm
+    from ..internal.pallas_chol import chol_panel_fused, chol_tile_pallas
+    from ..internal.pallas_lu import lu_panel_fused, lu_select_pallas
+    from ..internal.pallas_qr import qr_panel_pallas
+
+    rng = np.random.default_rng(_SEED)
+    interp = _interpret()
+    nb = min(plan.nb, n)
+    pallas = plan.kernel == "pallas"
+
+    if op == "potrf_tile":
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = jnp.asarray(g @ g.T + n * np.eye(n, dtype=np.float32))
+        if pallas:
+            fn = jax.jit(lambda x: chol_tile_pallas(x, bw=plan.bw,
+                                                    interpret=interp))
+        else:
+            fn = jax.jit(jnp.linalg.cholesky)
+        return (lambda: fn(a)), n ** 3 / 3
+
+    if op == "potrf_panel":
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = g @ g.T + n * np.eye(n, dtype=np.float32)
+        llead = np.linalg.cholesky(a[:nb, :nb]).astype(np.float32)
+        col = jnp.asarray(a[:, :nb])
+        left = jnp.asarray(np.tile(llead, (n // nb, 1)))
+        lead = jnp.asarray(llead.T)
+        if pallas:
+            fn = jax.jit(lambda c, lf, ld: chol_panel_fused(
+                c, lf, ld, bw=plan.bw, interpret=interp))
+        else:
+            def fn(c, lf, ld):
+                upd = c - lf @ ld
+                lkk = jnp.linalg.cholesky(upd[:nb])
+                return upd, jnp.concatenate(
+                    [lkk, upd[nb:] @ trsm.tri_inv_lower(lkk).T])
+            fn = jax.jit(fn)
+        flops = 2 * n * nb * nb + nb ** 3 / 3 + (n - nb) * nb ** 2
+        return (lambda: fn(col, left, lead)), flops
+
+    if op == "getrf_panel":
+        p = rng.standard_normal((n, nb)).astype(np.float32)
+        p[:nb] += nb * np.eye(nb, dtype=np.float32)
+        panel = jnp.asarray(p)
+        if pallas:
+            fn = jax.jit(lambda x: lu_panel_fused(x, bw=plan.bw,
+                                                  interpret=interp))
+        else:
+            fn = jax.jit(lambda x: getrf.panel_lu_nopiv(x)[0])
+        return (lambda: fn(panel)), n * nb ** 2
+
+    if op == "lu_select":
+        chunk = jnp.asarray(rng.standard_normal((n, nb)).astype(np.float32))
+        if pallas:
+            fn = jax.jit(lambda x: lu_select_pallas(x, bw=plan.bw,
+                                                    interpret=interp))
+        else:
+            fn = jax.jit(lambda x: jax.lax.linalg.lu(x)[2][:nb])
+        return (lambda: fn(chunk)), n * nb ** 2
+
+    if op == "geqrf_panel":
+        panel = jnp.asarray(rng.standard_normal((n, nb)).astype(np.float32))
+        if pallas:
+            fn = jax.jit(lambda x: qr_panel_pallas(x, interpret=interp))
+        else:
+            fn = jax.jit(qr.householder_panel_blocked)
+        return (lambda: fn(panel)), 2 * n * nb ** 2
+
+    raise ValueError(f"unknown op {op!r}")
+
+
+def measure(op: str, plan: TilePlan, n: int, iters: int = 3) -> float:
+    """GFLOP/s of one candidate (best of ``iters``, compile excluded)."""
+    import jax
+
+    thunk, flops = _problem(op, plan, n)
+    jax.block_until_ready(thunk())               # compile + warm caches
+    best = float("inf")
+    for _ in range(max(1, iters)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(thunk())
+        best = min(best, time.perf_counter() - t0)
+    return flops / best / 1e9
+
+
+def sweep(op: str, n: int, dtype: str = "float32", iters: int = 3):
+    """Yield (plan, gflops) for every candidate of (op, n, dtype)."""
+    for plan in candidates(op, n, dtype):
+        yield plan, measure(op, plan, n, iters=iters)
+
+
+def tune_op(op: str, n: int, dtype: str = "float32", iters: int = 3,
+            persist: bool = True) -> tuple[TilePlan, float]:
+    """Measure all candidates, persist the winner, return it."""
+    best_plan, best_gf = None, -1.0
+    for plan, gf in sweep(op, n, dtype, iters=iters):
+        if gf > best_gf:
+            best_plan, best_gf = plan, gf
+    if persist:
+        record_plan(op, n, dtype, best_plan, gflops=best_gf)
+    return best_plan, best_gf
+
+
+def tune_all(ns=(256, 512, 1024), ops=OPS, dtype: str = "float32",
+             iters: int = 3, persist: bool = True):
+    """Tune every (op, n) pair; returns {(op, n): (plan, gflops)}."""
+    out = {}
+    for op in ops:
+        for n in ns:
+            out[(op, n)] = tune_op(op, n, dtype, iters=iters,
+                                   persist=persist)
+    return out

@@ -35,6 +35,8 @@ def _lines(capsys):
 
 
 TOY = [
+    ("bench_potrf_fused", dict(n=256, nb=128, bw=8, iters=1)),
+    ("bench_geqrf_panel", dict(m=256, n=128, iters=1)),
     ("bench_gemm", dict(n=64, nb=32, iters=2)),
     ("bench_posv", dict(n=64, nb=32, nrhs=4, iters=1)),
     ("bench_gesv", dict(n=64, nb=32, nrhs=4, iters=1)),
@@ -150,3 +152,85 @@ def test_failures_are_isolated_and_main_exits_zero(bench, capsys,
     assert len(lines) == 1                # boom's error line; the lambda
     assert lines[0]["metric"] == "boom_error"   # emits nothing itself
     assert "synthetic" in lines[0]["error"]
+
+
+def test_watchdog_fires_and_exits_zero(bench, capsys, monkeypatch):
+    """The watchdog thread escapes even a stuck C++ compile (where SIGALRM
+    is queued but never delivered): past the grace deadline it emits a
+    skipped line for every step not yet done and hard-exits 0."""
+    monkeypatch.setattr(bench, "_WATCHDOG_GRACE_S", 0.0)
+    exited = []
+    fired = time.monotonic()
+
+    def fake_exit(rc):
+        exited.append((rc, time.monotonic() - fired))
+
+    def stuck():
+        pass                              # stands in for a blocked compile
+
+    steps = [(stuck, {}), (stuck, {})]
+    done = {0}                            # step 0 already emitted its line
+    stop = bench._install_watchdog(steps, deadline=time.monotonic() - 1,
+                                   done=done, exit_fn=fake_exit)
+    deadline = time.monotonic() + 5
+    while not exited and time.monotonic() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    assert exited and exited[0][0] == 0
+    lines = _lines(capsys)
+    assert len(lines) == 1                # only the NOT-done index reported
+    assert lines[0]["metric"] == "stuck_skipped"
+    assert "watchdog" in lines[0]["reason"]
+
+
+def test_watchdog_stands_down_on_stop(bench, capsys):
+    """stop.set() before the deadline means no exit and no skip lines."""
+    exited = []
+    stop = bench._install_watchdog([(time.sleep, {})],
+                                   deadline=time.monotonic() + 0.2,
+                                   done=set(), exit_fn=exited.append)
+    stop.set()
+    time.sleep(0.5)
+    assert exited == []
+    assert _lines(capsys) == []
+
+
+def test_main_arms_watchdog_before_first_compile(bench, monkeypatch):
+    """The r05 stall happened inside the FIRST compile; the watchdog must
+    already be armed when _chip_peak (first device contact) runs."""
+    order = []
+    monkeypatch.setattr(bench, "BUDGET_S", 30.0)
+    monkeypatch.setattr(
+        bench, "_install_watchdog",
+        lambda *a, **k: (order.append("watchdog"),
+                         __import__("threading").Event())[1])
+    monkeypatch.setattr(
+        bench, "_chip_peak",
+        lambda: (order.append("chip_peak"), (None, "cpu"))[1])
+    monkeypatch.setattr(bench, "_run_isolated", lambda *a, **k: 0)
+    assert bench.main() == 0
+    assert order == ["watchdog", "chip_peak"]
+
+
+def test_sweep_nb_mode_emits_candidate_lines(bench, capsys, monkeypatch):
+    """--sweep-nb emits one JSON line per candidate plan with the plan
+    knobs inline, and main still returns 0."""
+    from slate_tpu.tune import TilePlan, autotune
+
+    def fake_sweep(op, n, dtype, iters):
+        yield TilePlan(kernel="xla", nb=n, bw=8), 10.0
+        yield TilePlan(kernel="pallas", nb=128, bw=16), 20.0
+
+    monkeypatch.setattr(autotune, "sweep", fake_sweep)
+    monkeypatch.setattr(bench, "_chip_peak", lambda: (None, "cpu"))
+    rc = bench.main(("--sweep-nb",))
+    assert rc == 0
+    lines = _lines(capsys)
+    from slate_tpu.tune import OPS
+    assert len(lines) == 2 * len(OPS)
+    for line in lines:
+        assert line["metric"].startswith("sweep_")
+        assert line["kernel"] in ("xla", "pallas")
+        assert isinstance(line["nb"], int) and isinstance(line["bw"], int)
+        assert line["unit"] == "GFLOP/s"
+        assert line["value"] > 0

@@ -710,6 +710,87 @@ def test_reachability_entry_forms(tmp_path):
     assert reach.functions["slate_tpu/mod.py::c"].static_params == {"n"}
 
 
+PALLAS_PARTIAL = """\
+    from functools import partial
+
+    import jax.experimental.pallas as pl
+
+
+    def _kernel(a_ref, o_ref, *, bw):
+        o_ref[...] = a_ref[...] * bw
+
+
+    def run(a, bw):
+        return pl.pallas_call(
+            partial(_kernel, bw=bw),
+            out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype))(a)
+    """
+
+
+def test_pallas_call_partial_marks_kernel_entry(tmp_path):
+    """pallas_call(partial(_kernel, bw=bw), ...) must mark _kernel as a
+    traced entry with partial's keywords static — the fused-kernel idiom
+    (pallas_chol/pallas_lu) was invisible to reachability before."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": PALLAS_PARTIAL})
+    reach = reachability.compute(load_project(root))
+    info = reach.functions["slate_tpu/mod.py::_kernel"]
+    assert info.is_entry
+    assert info.static_params == {"bw"}
+
+
+def test_trc_fires_inside_partial_wrapped_kernel(tmp_path):
+    """A trace hazard INSIDE a partial-wrapped kernel body is now caught:
+    branching on ref data is TRC001, but branching on the partial-bound
+    static keyword is fine."""
+    bad = PALLAS_PARTIAL.replace(
+        "        o_ref[...] = a_ref[...] * bw\n",
+        "        if a_ref[0, 0] > 0:\n"
+        "            o_ref[...] = a_ref[...]\n")
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    root = mini_repo(bad_dir, {"slate_tpu/mod.py": bad})
+    assert "TRC001" in rule_ids(lint(root, {"TRC001"}))
+
+    good = PALLAS_PARTIAL.replace(
+        "        o_ref[...] = a_ref[...] * bw\n",
+        "        if bw > 4:\n"
+        "            o_ref[...] = a_ref[...]\n")
+    good_dir = tmp_path / "good"
+    good_dir.mkdir()
+    root2 = mini_repo(good_dir, {"slate_tpu/mod.py": good})
+    assert lint(root2, {"TRC001"}) == []
+
+
+def test_seam011_fires_on_raw_plan_cache_outside_tune(tmp_path):
+    """A driver touching the raw autotuner plan cache (instead of
+    resolve_plan) fires SEAM011; the tune package itself is exempt."""
+    files = seam_skeleton()
+    files["slate_tpu/drivers/qr.py"] = (
+        "from ..robust import health\n"
+        "from ..tune.plans import load_cache\n\n\n"
+        "def qr(a, opts=None):\n"
+        "    plans = load_cache()\n"
+        "    return health.finalize(a)\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM011"}
+    assert "load_cache" in fs[0].message
+
+
+def test_seam011_silent_inside_tune_and_via_resolver(tmp_path):
+    files = seam_skeleton()
+    files["slate_tpu/tune/plans.py"] = (
+        "def load_cache():\n    return {}\n\n\n"
+        "def resolve_plan(op, n, dtype='float32'):\n"
+        "    return load_cache().get(op)\n")
+    files["slate_tpu/drivers/qr.py"] = (
+        "from ..robust import health\n"
+        "from ..tune.plans import resolve_plan\n\n\n"
+        "def qr(a, opts=None):\n"
+        "    plan = resolve_plan('geqrf_panel', 128)\n"
+        "    return health.finalize(a)\n")
+    assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
+
+
 def test_registry_has_required_rule_surface():
     assert len(REGISTRY) >= 14
     packs = {"TRC", "COL", "SEAM"}

@@ -1,0 +1,185 @@
+"""Autotuner plan store tests (slate_tpu/tune/): schema validation, the
+record -> persist -> reload -> resolve round trip (including under jit,
+where the resolved plan must lower to a pallas_call), nearest-n lookup,
+the plan_override test seam, and the SLATE_PALLAS deprecation shim."""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import tune
+from slate_tpu.tune import (OPS, SCHEMA_VERSION, TilePlan, XLA_PLAN,
+                            plan_override, record_plan, resolve_plan,
+                            validate_cache)
+
+
+@pytest.fixture
+def cache(tmp_path, monkeypatch):
+    """Point the plan cache at a fresh temp file for the test's scope."""
+    path = tmp_path / "plans.json"
+    monkeypatch.setenv("SLATE_TUNE_CACHE", str(path))
+    monkeypatch.delenv("SLATE_PALLAS", raising=False)
+    tune.reload()
+    yield path
+    tune.reload()
+
+
+# ---- schema -------------------------------------------------------------
+
+
+def _good_cache():
+    return {"version": SCHEMA_VERSION, "chips": {"cpu": {
+        "potrf_tile": {"n=512,dtype=float32":
+                       {"kernel": "pallas", "nb": 512, "bw": 8,
+                        "gflops": 123.4}}}}}
+
+
+def test_schema_accepts_good_cache():
+    validate_cache(_good_cache())                 # must not raise
+    validate_cache({"version": SCHEMA_VERSION, "chips": {}})
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda o: o.update(version=99), "version"),
+    (lambda o: o.update(extra=1), "unknown top-level"),
+    (lambda o: o.pop("chips"), "chips"),
+    (lambda o: o["chips"].update(cpu={"bogus_op": {}}), "unknown op"),
+    (lambda o: o["chips"]["cpu"]["potrf_tile"].update(
+        {"n=1,dtype=f32": {"kernel": "magic", "nb": 1, "bw": 1}}), "kernel"),
+    (lambda o: o["chips"]["cpu"]["potrf_tile"].update(
+        {"n=1,dtype=f32": {"kernel": "xla", "nb": -4, "bw": 1}}), "nb"),
+    (lambda o: o["chips"]["cpu"]["potrf_tile"].update(
+        {"badkey": {"kernel": "xla", "nb": 1, "bw": 1}}), "key"),
+], ids=["version", "extra-key", "no-chips", "bad-op", "bad-kernel",
+        "bad-nb", "bad-entry-key"])
+def test_schema_rejects_bad_cache(mutate, msg):
+    obj = _good_cache()
+    mutate(obj)
+    with pytest.raises(ValueError):
+        validate_cache(obj)
+
+
+def test_repo_ships_no_invalid_default_cache(cache):
+    """A fresh (missing) cache file resolves every op to the XLA plan."""
+    for op in OPS:
+        assert resolve_plan(op, 512) == XLA_PLAN
+
+
+# ---- round trip ---------------------------------------------------------
+
+
+def test_record_reload_resolve_roundtrip(cache):
+    plan = TilePlan(kernel="pallas", nb=256, bw=16)
+    record_plan("potrf_tile", 512, "float32", plan, gflops=42.0)
+    assert cache.exists()
+    on_disk = json.loads(cache.read_text())
+    validate_cache(on_disk)
+    chip = tune.chip_kind()
+    ent = on_disk["chips"][chip]["potrf_tile"]["n=512,dtype=float32"]
+    assert ent == {"kernel": "pallas", "nb": 256, "bw": 16, "gflops": 42.0}
+    assert resolve_plan("potrf_tile", 512) == plan
+    # other ops stay untuned
+    assert resolve_plan("geqrf_panel", 512) == XLA_PLAN
+
+
+def test_nearest_n_lookup(cache):
+    near = TilePlan(kernel="pallas", nb=128, bw=8)
+    far = TilePlan(kernel="pallas", nb=512, bw=16)
+    record_plan("potrf_tile", 256, "float32", near)
+    record_plan("potrf_tile", 4096, "float32", far)
+    assert resolve_plan("potrf_tile", 384) == near     # log2-nearest
+    assert resolve_plan("potrf_tile", 3000) == far
+    # dtype must match exactly: no f32 plan leaks onto f64 calls
+    assert resolve_plan("potrf_tile", 256, "float64") == XLA_PLAN
+
+
+def test_resolved_plan_routes_pallas_under_jit(cache):
+    """The cached plan is read at TRACE time: a jitted driver seam lowers
+    to a pallas_call when the plan says pallas, with no cache access in
+    the compiled program."""
+    from slate_tpu.internal.potrf import potrf_tile
+    record_plan("potrf_tile", 128, "float32",
+                TilePlan(kernel="pallas", nb=128, bw=8))
+    rng = np.random.default_rng(0)
+    a0 = rng.standard_normal((128, 128)).astype(np.float32) * 0.1
+    a = jnp.asarray(a0 @ a0.T + 128 * np.eye(128, dtype=np.float32))
+    # fresh lambdas per trace: make_jaxpr caches by function identity +
+    # avals, which would otherwise replay the first route
+    jaxpr = str(jax.make_jaxpr(lambda x: potrf_tile(x))(a))
+    assert "pallas_call" in jaxpr
+    L = np.asarray(jax.jit(potrf_tile)(a))
+    np.testing.assert_allclose(L, np.linalg.cholesky(np.asarray(a)),
+                               rtol=2e-5, atol=5e-5)
+    # and the XLA route stays pallas-free
+    tune.reload()
+    with plan_override("potrf_tile", XLA_PLAN):
+        assert "pallas_call" not in str(
+            jax.make_jaxpr(lambda x: potrf_tile(x))(a))
+
+
+def test_corrupt_cache_file_warns_and_falls_back(cache):
+    cache.write_text('{"version": 99}')
+    tune.reload()
+    with pytest.warns(UserWarning, match="ignoring bad plan cache"):
+        assert resolve_plan("potrf_tile", 512) == XLA_PLAN
+
+
+# ---- overrides and the deprecated env knob ------------------------------
+
+
+def test_plan_override_scopes_and_restores(cache):
+    forced = TilePlan(kernel="pallas", nb=128, bw=16)
+    with plan_override("getrf_panel", forced):
+        assert resolve_plan("getrf_panel", 384) == forced
+        with plan_override("getrf_panel", XLA_PLAN):
+            assert resolve_plan("getrf_panel", 384) == XLA_PLAN
+        assert resolve_plan("getrf_panel", 384) == forced
+    assert resolve_plan("getrf_panel", 384) == XLA_PLAN
+    with pytest.raises(ValueError):
+        with plan_override("bogus", forced):
+            pass
+
+
+def test_slate_pallas_env_is_deprecated_but_honored(cache, monkeypatch):
+    monkeypatch.setenv("SLATE_PALLAS", "1")
+    monkeypatch.setattr(tune.plans, "_WARNED", False)
+    with pytest.warns(DeprecationWarning, match="SLATE_PALLAS is "
+                      "deprecated"):
+        plan = resolve_plan("potrf_tile", 256)
+    assert plan.kernel == "pallas"                  # force-on fallback
+    # force-off beats a cached pallas plan
+    record_plan("potrf_tile", 256, "float32",
+                TilePlan(kernel="pallas", nb=256, bw=8))
+    monkeypatch.setenv("SLATE_PALLAS", "0")
+    assert resolve_plan("potrf_tile", 256) == XLA_PLAN
+    # the warning fires once per process
+    monkeypatch.setattr(tune.plans, "_WARNED", True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        resolve_plan("potrf_tile", 256)
+
+
+# ---- autotune measurement layer -----------------------------------------
+
+
+def test_candidates_cover_xla_and_legal_pallas():
+    from slate_tpu.tune import autotune
+    cands = list(autotune.candidates("potrf_panel", 512, "float32"))
+    assert XLA_PLAN in cands
+    pallas = [c for c in cands if c.kernel == "pallas"]
+    assert pallas and all(512 % c.nb == 0 for c in pallas)
+    # geqrf_panel has no bw knob: one pallas candidate per nb
+    qr = list(autotune.candidates("geqrf_panel", 512, "float32"))
+    assert len({(c.kernel, c.nb) for c in qr}) == len(qr)
+
+
+@pytest.mark.slow
+def test_tune_op_persists_winner(cache):
+    from slate_tpu.tune import autotune
+    plan, gflops = autotune.tune_op("potrf_tile", 128, "float32", iters=1)
+    assert gflops > 0
+    assert resolve_plan("potrf_tile", 128) == plan

@@ -5,7 +5,9 @@ A function is traced when jax traces it rather than running it eagerly:
 - **direct entries** — decorated with ``@jax.jit`` / ``@jit`` /
   ``@partial(jit, ...)``, or passed as the callable to ``jax.jit(f)``,
   ``jax.shard_map(f, ...)``, ``shard_map_unchecked(f, ...)`` (the compat
-  shim in ``util/compat_jax.py``) or ``pl.pallas_call(kernel, ...)``;
+  shim in ``util/compat_jax.py``), ``pl.pallas_call(kernel, ...)`` or
+  ``pl.pallas_call(partial(kernel, bw=bw), ...)`` (partial keywords are
+  static parameters of the kernel entry);
 - **transitively traced** — reachable from a traced function through the
   lexically-resolvable call graph: direct calls, bare function references
   (e.g. a body handed to ``lax.fori_loop`` / ``lax.scan``), and nested
@@ -283,6 +285,15 @@ class Reachability:
         if isinstance(target, ast.Name):
             self._mark_entry(self.resolve_name(target.id, scope, rel),
                              static)
+        elif (isinstance(target, ast.Call)
+              and self._callable_name(target.func) == "partial"
+              and target.args and isinstance(target.args[0], ast.Name)):
+            # pallas_call(partial(_kernel, bw=bw), ...): the kernel is the
+            # traced entry; partial's keyword bindings are closure values
+            # fixed at trace time, hence static parameters of the kernel.
+            self._mark_entry(
+                self.resolve_name(target.args[0].id, scope, rel),
+                {kw.arg for kw in target.keywords if kw.arg is not None})
         elif isinstance(target, ast.Lambda):
             # the lambda body is traced: its resolvable callees are roots.
             # Only arguments fed from the LAMBDA'S OWN parameters are

@@ -22,7 +22,13 @@ SEAM007 point 7 — robust/abft.py policy-free and raise-free
 SEAM008 point 8 — ABFT boundaries resolve_abft exactly once
 SEAM009 point 9 — maybe_corrupt sites are literals from faults.SITES
 SEAM010 point 10 — Option.Abft never read in a driver module
+SEAM011 (new, PR 7) — the raw autotuner plan cache (load_cache /
+        save_cache / cache_path / record_plan) is only touched inside
+        slate_tpu/tune/; everything else goes through resolve_plan
 ====== ===============================================================
+
+SEAM011 has no legacy twin (it postdates the migration); its ``legacy``
+string is the modern ``path:line: msg`` form.
 """
 
 from __future__ import annotations
@@ -59,6 +65,12 @@ RECOVERY_BOUNDARIES = {"gesv_with_recovery", "gels_with_recovery",
                        "hesv_with_recovery"}
 RBT_MODULE = "slate_tpu/internal/rbt.py"
 FINALIZE_NAMES = {"finalize", "_finalize_solve"}
+
+TUNE_DIR = "slate_tpu/tune"
+#: raw plan-cache accessors: consuming code must use resolve_plan instead,
+#: so a cache-format change (or a corrupt cache file) has ONE blast radius
+RAW_PLAN_CACHE_NAMES = {"load_cache", "save_cache", "cache_path",
+                        "record_plan"}
 
 ABFT_MODULE = "slate_tpu/robust/abft.py"
 FAULTS_MODULE = "slate_tpu/robust/faults.py"
@@ -190,6 +202,7 @@ def seam_scan(project) -> list[tuple[str, Finding]]:
     out.extend(_scan_speculation(project))
     out.extend(_scan_abft(project))
     out.extend(_scan_driver_contract(project))
+    out.extend(_scan_tune(project))
     project.cache["seam_scan"] = out
     return out
 
@@ -401,6 +414,35 @@ def _scan_driver_contract(project):
                            f"Option.ErrorPolicy cannot reach it"))
 
 
+def _scan_tune(project):
+    # SEAM011: the raw plan cache is tune/'s private substrate.  Drivers
+    # and internal kernels consume plans ONLY via resolve_plan (or the
+    # plan_override test seam) — never by reading/writing the cache file.
+    for rel in _slate_modules(project):
+        if rel.startswith(TUNE_DIR + "/") or rel == TUNE_DIR + ".py":
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, (ast.ImportFrom, ast.Import)):
+                aliased = [a.name for a in node.names]
+                hits = RAW_PLAN_CACHE_NAMES.intersection(aliased)
+                if hits:
+                    name = sorted(hits)[0]
+            if name in RAW_PLAN_CACHE_NAMES:
+                msg = (f"touches the raw autotuner plan cache "
+                       f"(`{name}`) outside slate_tpu/tune/ — consume "
+                       f"plans via resolve_plan so the cache format has "
+                       f"one blast radius")
+                yield ("SEAM011", Finding(
+                    "SEAM011", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: {msg}"))
+
+
 def legacy_report(project) -> list[str]:
     """The pre-migration checker's report lines, in its order, honoring
     per-line suppressions (the legacy checker predates suppressions, so a
@@ -444,3 +486,6 @@ _make("SEAM008", "ABFT boundaries resolve_abft exactly once")
 _make("SEAM009", "maybe_corrupt sites are string literals from "
       "faults.SITES — a closed, greppable vocabulary")
 _make("SEAM010", "no driver module reads the raw Option.Abft knob")
+_make("SEAM011", "the raw autotuner plan cache (load/save/cache_path/"
+      "record_plan) is only touched inside slate_tpu/tune/ — consumers "
+      "go through resolve_plan")
