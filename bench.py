@@ -51,6 +51,7 @@ from jax import lax
 
 import slate_tpu as st
 from slate_tpu.core.storage import TileStorage
+from slate_tpu.obs.metrics import BENCH_SCHEMA
 
 BASELINE_GFLOPS_PER_CHIP = 702.0  # ref docs/usage.md:41-42, per-GPU dgemm
 QUICK = bool(int(os.environ.get("SLATE_BENCH_QUICK", "0")))
@@ -79,6 +80,12 @@ def _chip_peak():
 
 PEAK, CHIP = None, "cpu"
 
+# Live progress shared with the watchdog thread: which step index is in
+# flight, whether it is compiling or running timed reps, and when it
+# started — so a budget skip line can say WHERE the time went (a stall in
+# a 400 s compile reads very differently from a slow run phase).
+_PROGRESS = {"idx": None, "phase": None, "t0": None}
+
 
 def _mat(dense, mb, nb):
     return st.Matrix(TileStorage.from_dense(dense, mb, nb))
@@ -102,7 +109,9 @@ def _time_chain(body, init, args, iters, flops_per_iter, reps=3):
         return c
 
     run = jax.jit(chained)
+    _PROGRESS["phase"] = "compile"
     np.asarray(jax.device_get(run(init, *args)))   # compile + warmup
+    _PROGRESS["phase"] = "run"
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -113,6 +122,7 @@ def _time_chain(body, init, args, iters, flops_per_iter, reps=3):
 
 def _emit(metric, gflops, extra=None):
     line = {
+        "schema": BENCH_SCHEMA,
         "metric": metric,
         "value": round(float(gflops), 1),
         "unit": "GFLOP/s",
@@ -424,12 +434,18 @@ class _BudgetExceeded(Exception):
     """Raised by the SIGALRM handler when a metric overruns the pool."""
 
 
-def _skip_line(fn, reason):
-    print(json.dumps({
+def _skip_line(fn, reason, phase=None, elapsed_s=None):
+    line = {
+        "schema": BENCH_SCHEMA,
         "metric": f"{fn.__name__}_skipped", "value": None,
         "unit": "GFLOP/s", "vs_baseline": None,
-        "skipped": True, "reason": reason,
-    }), flush=True)
+        "skipped": True, "reason": reason, "chip": CHIP,
+    }
+    if phase is not None:
+        line["phase"] = phase
+    if elapsed_s is not None:
+        line["elapsed_s"] = round(float(elapsed_s), 1)
+    print(json.dumps(line), flush=True)
 
 
 # Test seam: the watchdog's hard exit.  os._exit (not sys.exit) because the
@@ -465,7 +481,12 @@ def _install_watchdog(steps, deadline, done, exit_fn=None):
             return
         for idx, (fn, _) in enumerate(steps):
             if idx not in done:
-                _skip_line(fn, "time budget exceeded (watchdog)")
+                if idx == _PROGRESS["idx"] and _PROGRESS["t0"] is not None:
+                    _skip_line(fn, "time budget exceeded (watchdog)",
+                               phase=_PROGRESS["phase"],
+                               elapsed_s=time.monotonic() - _PROGRESS["t0"])
+                else:
+                    _skip_line(fn, "time budget exceeded (watchdog)")
         (exit_fn or _EXIT)(0)
 
     threading.Thread(target=_watch, name="bench-watchdog",
@@ -509,15 +530,19 @@ def _run_isolated(steps, budget_s=None, done=None, deadline=None):
                 raise _BudgetExceeded
             prev = signal.signal(signal.SIGALRM, _on_alarm)
             signal.setitimer(signal.ITIMER_REAL, remaining)
+        _PROGRESS.update(idx=idx, phase="compile", t0=time.monotonic())
         try:
             fn(**kwargs)
         except _BudgetExceeded:
-            _skip_line(fn, "time budget exceeded (preempted)")
+            _skip_line(fn, "time budget exceeded (preempted)",
+                       phase=_PROGRESS["phase"],
+                       elapsed_s=time.monotonic() - _PROGRESS["t0"])
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             failures += 1
             print(json.dumps({
+                "schema": BENCH_SCHEMA,
                 "metric": f"{fn.__name__}_error", "value": None,
-                "unit": "GFLOP/s", "vs_baseline": None,
+                "unit": "GFLOP/s", "vs_baseline": None, "chip": CHIP,
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }), flush=True)
         finally:
@@ -551,6 +576,7 @@ def sweep_nb():
             for plan, gflops in autotune.sweep(op, n, "float32",
                                                iters=iters):
                 print(json.dumps({
+                    "schema": BENCH_SCHEMA,
                     "metric": f"sweep_{op}_n{n}", "op": op, "n": n,
                     "kernel": plan.kernel, "nb": plan.nb, "bw": plan.bw,
                     "value": round(float(gflops), 1), "unit": "GFLOP/s",
@@ -558,8 +584,9 @@ def sweep_nb():
                 }), flush=True)
         except Exception as exc:  # noqa: BLE001 — isolate, report, continue
             print(json.dumps({
+                "schema": BENCH_SCHEMA,
                 "metric": f"sweep_{op}_n{n}_error", "value": None,
-                "unit": "GFLOP/s", "vs_baseline": None,
+                "unit": "GFLOP/s", "vs_baseline": None, "chip": chip,
                 "error": f"{type(exc).__name__}: {exc}"[:300],
             }), flush=True)
 

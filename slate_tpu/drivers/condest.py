@@ -30,6 +30,7 @@ from ..internal.qr import phase_of
 from ..options import ErrorPolicy, Options
 from ..robust import health as _health
 from ..types import Norm, Uplo
+from ..util.trace import annotate
 
 
 def _norm1est_flag(apply_inv, apply_inv_h, n: int, dtype, itmax: int = 5):
@@ -102,6 +103,7 @@ def _condest_result(name, rcond, bad, dtype, opts):
     return rcond
 
 
+@annotate("slate.gecondest")
 def gecondest(F, anorm, opts: Options | None = None, norm: Norm = Norm.One):
     """Reciprocal condition estimate from LU factors (ref:
     src/gecondest.cc): rcond = 1 / (||A|| * est(||A^-1||)).
@@ -147,6 +149,7 @@ def gecondest(F, anorm, opts: Options | None = None, norm: Norm = Norm.One):
     return _condest_result("gecondest", rcond, bad, lu.dtype, opts)
 
 
+@annotate("slate.trcondest")
 def trcondest(R, opts: Options | None = None, norm: Norm = Norm.One):
     """Reciprocal condition estimate of a triangular matrix (ref:
     src/trcondest.cc — used on QR's R factor for least-squares

@@ -40,7 +40,7 @@ from ..robust import certify as _certify
 from ..robust import faults as _faults
 from ..robust import health as _health
 from ..types import Op, Uplo, is_complex
-from ..util.trace import annotate
+from ..util.trace import annotate, span
 
 
 def _notconv_exc(name):
@@ -295,6 +295,7 @@ def _stage2_eig(band, nb: int, jobz: bool, opts: Options | None,
     return w, Q2 @ ztri.astype(Q2.dtype), h
 
 
+@annotate("slate.sterf")
 def sterf(d, e, opts: Options | None = None):
     """Eigenvalues of a real symmetric tridiagonal (d, e) — no vectors
     (ref: src/sterf.cc wrapping LAPACK sterf).  Under ``ErrorPolicy.Info``
@@ -303,6 +304,7 @@ def sterf(d, e, opts: Options | None = None):
     return _health.finalize("sterf", w, h, opts, _notconv_exc("sterf"))
 
 
+@annotate("slate.steqr")
 def steqr(d, e, opts: Options | None = None):
     """Eigendecomposition of a real symmetric tridiagonal (d, e)
     (ref: src/steqr2.cc QR iteration with distributed Z rows — here the
@@ -350,15 +352,18 @@ def heev_info(A, opts: Options | None = None, *, jobz: bool = True):
         w, Zm, h = _heev_mesh(A, opts, jobz)
     else:
         ad = A.to_dense()
-        Vs, Ts, Ds, Ss = _he2hb_scan(ad, nb)
-        band = _band_from_stacks(Ds, Ss, n, nb)
-        w, Z2, h = _stage2_eig(band, nb, jobz, opts)
+        with span("slate.heev/he2hb"):
+            Vs, Ts, Ds, Ss = _he2hb_scan(ad, nb)
+            band = _band_from_stacks(Ds, Ss, n, nb)
+        with span("slate.heev/stage2"):
+            w, Z2, h = _stage2_eig(band, nb, jobz, opts)
         if jobz:
-            N = Ds.shape[0] * nb
-            Zpad = jnp.zeros((N, n), Z2.dtype).at[:n].set(Z2)
-            Z = _unmtr_he2hb_stack(Vs, Ts, nb, Zpad)[:n]
-            Z = _faults.maybe_corrupt("post_backtransform", Z)
-            Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
+            with span("slate.heev/backtransform"):
+                N = Ds.shape[0] * nb
+                Zpad = jnp.zeros((N, n), Z2.dtype).at[:n].set(Z2)
+                Z = _unmtr_he2hb_stack(Vs, Ts, nb, Zpad)[:n]
+                Z = _faults.maybe_corrupt("post_backtransform", Z)
+                Zm = Matrix(TileStorage.from_dense(Z, A.mb, A.nb, A.grid))
         else:
             Zm = None
     if jobz:
@@ -412,26 +417,31 @@ def _heev_mesh(A, opts, jobz: bool):
         st_in = TileStorage.from_dense(A.to_dense(), nb, nb, grid)
     from ..parallel.dist_chol import SUPERBLOCKS, superblock
     la = max(1, int(get_option(opts, Option.Lookahead)))
-    data, Ts = dist_he2hb(st_in.data, st_in.Nt, grid, n=n,
-                          sb=superblock(max(st_in.Nt - 1, 1),
-                                        SUPERBLOCKS * la))
-    st_packed = TileStorage(data, st_in.m, st_in.n, nb, nb, grid)
-    band = _band_from_tiles(st_packed, n, nb)
+    with span("slate.heev/he2hb"):
+        data, Ts = dist_he2hb(st_in.data, st_in.Nt, grid, n=n,
+                              sb=superblock(max(st_in.Nt - 1, 1),
+                                            SUPERBLOCKS * la))
+        st_packed = TileStorage(data, st_in.m, st_in.n, nb, nb, grid)
+        band = _band_from_tiles(st_packed, n, nb)
     # ONE stage-2 dispatch shared with the single-target path; the DC
     # route's merge gemms are row-distributed over this grid's mesh
     # (drivers/stedc.py _merge_gemm), the rest of stage 2 is single-node
     # by design, as the reference's is
-    w, Z2, h = _stage2_eig(band, nb, jobz, opts, grid)
+    with span("slate.heev/stage2"):
+        w, Z2, h = _stage2_eig(band, nb, jobz, opts, grid)
     if not jobz:
         return w, None, h
-    Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))
-    z_data = dist_unmtr_he2hb(data, Ts, Z0.storage.data, st_in.Nt, grid, n=n)
-    z_data = _faults.maybe_corrupt("post_backtransform", z_data)
+    with span("slate.heev/backtransform"):
+        Z0 = Matrix(TileStorage.from_dense(Z2, nb, nb, grid))
+        z_data = dist_unmtr_he2hb(data, Ts, Z0.storage.data, st_in.Nt,
+                                  grid, n=n)
+        z_data = _faults.maybe_corrupt("post_backtransform", z_data)
     zs = Z0.storage
     return (w, Matrix(TileStorage(z_data, zs.m, zs.n, zs.mb, zs.nb,
                                   zs.grid)), h)
 
 
+@annotate("slate.heevd")
 def heevd(A, opts: Options | None = None):
     """Eigenvalues AND vectors, divide-and-conquer flavor — the LAPACK
     heevd contract (our seams are XLA's eigh, itself D&C/QDWH;
@@ -439,6 +449,7 @@ def heevd(A, opts: Options | None = None):
     return heev(A, opts, jobz=True)
 
 
+@annotate("slate.heev_vals")
 def heev_vals(A, opts: Options | None = None):
     """Eigenvalues only (ref: heev with Job::NoVec; simplified_api
     eig_vals).  Values-only twin of svd_vals.  Under ``ErrorPolicy.Info``
@@ -450,6 +461,7 @@ def heev_vals(A, opts: Options | None = None):
     return res[0]
 
 
+@annotate("slate.hegst")
 def hegst(A, L, opts: Options | None = None, *, itype: int = 1):
     """Reduce a generalized Hermitian-definite problem to standard form
     with B = L L^H (ref: src/hegst.cc:40-41 supports itype 1/2/3):

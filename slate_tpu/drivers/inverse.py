@@ -16,6 +16,7 @@ from ..exceptions import SlateSingularError, slate_error
 from ..options import Options
 from ..robust import health as _health
 from ..types import Diag, Uplo
+from ..util.trace import annotate
 
 
 def _singular_exc(name):
@@ -25,6 +26,7 @@ def _singular_exc(name):
     return make
 
 
+@annotate("slate.trtri")
 def trtri(A: TriangularMatrix, opts: Options | None = None):
     """Triangular inverse (ref: src/trtri.cc).  Solves op(T) X = I
     through the trsm driver, so the execution target follows trsm's:
@@ -56,6 +58,7 @@ def trtri(A: TriangularMatrix, opts: Options | None = None):
     return _health.finalize("trtri", Xt, h, opts, _singular_exc("trtri"))
 
 
+@annotate("slate.trtrm")
 def trtrm(L: TriangularMatrix, opts: Options | None = None):
     """Hermitian product of a triangular factor with its adjoint
     (ref: src/trtrm.cc).  For Linv lower: returns Linv^H Linv, i.e. the

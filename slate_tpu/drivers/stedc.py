@@ -46,6 +46,7 @@ from ..robust import certify as _certify
 from ..robust import faults as _faults
 from ..robust import health as _health
 from ..types import eps as _eps
+from ..util.trace import annotate, span
 
 LEAF = 32
 
@@ -339,15 +340,18 @@ def stedc_info(d, e, grid=None, certify=True):
     # ~3 digits of orthogonality per level (measured ~2e-2 vs ~1e-4 at
     # n=64 f32) — same discipline as hetrf's recurrence gemms
     with jax.default_matmul_precision("highest"):
-        w, Z, ok = _stedc_rec(d, e, grid)
+        with span("slate.stedc/recurse"):
+            w, Z, ok = _stedc_rec(d, e, grid)
         flags = _health.healthy(d.dtype)._replace(converged=ok)
         if not certify:
             return (w, Z), _health.merge(flags, _health.from_result(w))
-        T = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
-        cert = _certify.certify_eig(T, w, Z)
+        with span("slate.stedc/certify"):
+            T = jnp.diag(d) + jnp.diag(e, 1) + jnp.diag(e, -1)
+            cert = _certify.certify_eig(T, w, Z)
     return (w, Z), _health.merge(cert, flags, _health.from_result(w))
 
 
+@annotate("slate.stedc")
 def stedc(d, e, grid=None, opts: Options | None = None):
     """Eigendecomposition of the symmetric tridiagonal (d, e) by divide &
     conquer (ref: src/stedc.cc).  Returns (w, Z) ascending; under

@@ -35,7 +35,7 @@ from ..robust import certify as _certify
 from ..robust import faults as _faults
 from ..robust import health as _health
 from ..types import Op, is_complex
-from ..util.trace import annotate
+from ..util.trace import annotate, span
 
 
 def _notconv_exc(name):
@@ -226,6 +226,7 @@ def _bd_svd(d, e, want_uv: bool):
     return jnp.linalg.svd(B, compute_uv=False), None, None
 
 
+@annotate("slate.bdsqr")
 def bdsqr(d, e, opts: Options | None = None):
     """SVD of a real upper bidiagonal (d, e) as a public driver
     (ref: src/bdsqr.cc wrapping lapack::bdsqr).  Returns (s, U, Vh);
@@ -315,22 +316,25 @@ def _svd_compute(A: Matrix, opts: Options | None, jobu: bool):
         return _svd_mesh(A, opts, jobu)
     nb = A.nb
     ad = A.to_dense()
-    Vqs, Tqs, Vls, Tls, Ds, Ss = _ge2tb_scan(ad, nb)
-    band = _band_upper_from_stacks(Ds, Ss, n, nb)
-    s, Un, Vn, h = _stage2_svd(band, nb, jobu, opts)
+    with span("slate.svd/ge2tb"):
+        Vqs, Tqs, Vls, Tls, Ds, Ss = _ge2tb_scan(ad, nb)
+        band = _band_upper_from_stacks(Ds, Ss, n, nb)
+    with span("slate.svd/stage2"):
+        s, Un, Vn, h = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
         return s, None, None, h
-    dt = ad.dtype
-    Mp = Vqs.shape[1]
-    Np = -(-n // nb) * nb
-    Upad = jnp.zeros((Mp, n), dt).at[:n, :n].set(Un.astype(dt))
-    Ufull = _unmbr_ge2tb_u(Vqs, Tqs, nb, Upad)[:m]
-    Ufull = _faults.maybe_corrupt("post_backtransform", Ufull)
-    Vpad = jnp.zeros((Np, n), dt).at[:n].set(Vn.astype(dt))
-    Vfull = _unmbr_ge2tb_v(Vls, Tls, nb, Vpad)[:n]
-    g = A.grid
-    Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
-    Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
+    with span("slate.svd/backtransform"):
+        dt = ad.dtype
+        Mp = Vqs.shape[1]
+        Np = -(-n // nb) * nb
+        Upad = jnp.zeros((Mp, n), dt).at[:n, :n].set(Un.astype(dt))
+        Ufull = _unmbr_ge2tb_u(Vqs, Tqs, nb, Upad)[:m]
+        Ufull = _faults.maybe_corrupt("post_backtransform", Ufull)
+        Vpad = jnp.zeros((Np, n), dt).at[:n].set(Vn.astype(dt))
+        Vfull = _unmbr_ge2tb_v(Vls, Tls, nb, Vpad)[:n]
+        g = A.grid
+        Um = Matrix(TileStorage.from_dense(Ufull, A.mb, A.nb, g))
+        Vm = Matrix(TileStorage.from_dense(Vfull, A.nb, A.nb, g))
     return s, Um, Vm, h
 
 
@@ -397,39 +401,45 @@ def _svd_mesh(A: Matrix, opts, jobu: bool):
         st_in = TileStorage.from_dense(A.to_dense(), nb, nb, grid)
     from ..parallel.dist_chol import SUPERBLOCKS, superblock
     la = max(1, int(get_option(opts, Option.Lookahead)))
-    data, Tqs, Tls = dist_ge2tb(st_in.data, st_in.Mt, st_in.Nt, m, n, grid,
-                                sb=superblock(max(st_in.Nt, 1),
-                                              SUPERBLOCKS * la))
-    st_packed = TileStorage(data, m, n, nb, nb, grid)
-    band = _band_upper_from_tiles(st_packed, n, nb)
+    with span("slate.svd/ge2tb"):
+        data, Tqs, Tls = dist_ge2tb(st_in.data, st_in.Mt, st_in.Nt, m, n,
+                                    grid,
+                                    sb=superblock(max(st_in.Nt, 1),
+                                                  SUPERBLOCKS * la))
+        st_packed = TileStorage(data, m, n, nb, nb, grid)
+        band = _band_upper_from_tiles(st_packed, n, nb)
     # ONE stage-2 dispatch shared with the single-target path (stage 2 is
     # single-node by design, as the reference's is); only the stage-1
     # back-transforms below are mesh-distributed
-    s, Uns, Vns, h = _stage2_svd(band, nb, jobu, opts)
+    with span("slate.svd/stage2"):
+        s, Uns, Vns, h = _stage2_svd(band, nb, jobu, opts)
     if not jobu:
         return s, None, None, h
-    dt = st_packed.dtype
-    Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
-    Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
-    # U = U1 [Un; 0], V = V1 Vn, both distributed panel chains.  Pad Un
-    # [n, n] to [m, n] in TILE space — a static cyclic-slot scatter, never
-    # a replicated [m, n] dense intermediate (m can be huge for tall A)
-    Uf = Matrix.zeros(m, n, nb, nb, grid, st_packed.dtype)
-    us_, fs_ = Un.storage, Uf.storage
-    gsrc = np.arange(us_.Mt)
-    src = (gsrc % grid.p) * us_.mtl + gsrc // grid.p
-    dst = (gsrc % grid.p) * fs_.mtl + gsrc // grid.p
-    uf_data = fs_.data.at[dst].set(us_.data[src])
-    Uf = Matrix(TileStorage(uf_data, m, n, nb, nb, grid))
-    u_data = dist_unmbr_ge2tb_u(data, Tqs, Uf.storage.data, grid, m)
-    u_data = _faults.maybe_corrupt("post_backtransform", u_data)
-    v_data = dist_unmbr_ge2tb_v(data, Tls, Vn.storage.data, grid, n)
-    us, vs = Uf.storage, Vn.storage
-    Um = Matrix(TileStorage(u_data, us.m, us.n, us.mb, us.nb, us.grid))
-    Vm = Matrix(TileStorage(v_data, vs.m, vs.n, vs.mb, vs.nb, vs.grid))
+    with span("slate.svd/backtransform"):
+        dt = st_packed.dtype
+        Un = Matrix(TileStorage.from_dense(Uns.astype(dt), nb, nb, grid))
+        Vn = Matrix(TileStorage.from_dense(Vns.astype(dt), nb, nb, grid))
+        # U = U1 [Un; 0], V = V1 Vn, both distributed panel chains.  Pad Un
+        # [n, n] to [m, n] in TILE space — a static cyclic-slot scatter,
+        # never a replicated [m, n] dense intermediate (m can be huge for
+        # tall A)
+        Uf = Matrix.zeros(m, n, nb, nb, grid, st_packed.dtype)
+        us_, fs_ = Un.storage, Uf.storage
+        gsrc = np.arange(us_.Mt)
+        src = (gsrc % grid.p) * us_.mtl + gsrc // grid.p
+        dst = (gsrc % grid.p) * fs_.mtl + gsrc // grid.p
+        uf_data = fs_.data.at[dst].set(us_.data[src])
+        Uf = Matrix(TileStorage(uf_data, m, n, nb, nb, grid))
+        u_data = dist_unmbr_ge2tb_u(data, Tqs, Uf.storage.data, grid, m)
+        u_data = _faults.maybe_corrupt("post_backtransform", u_data)
+        v_data = dist_unmbr_ge2tb_v(data, Tls, Vn.storage.data, grid, n)
+        us, vs = Uf.storage, Vn.storage
+        Um = Matrix(TileStorage(u_data, us.m, us.n, us.mb, us.nb, us.grid))
+        Vm = Matrix(TileStorage(v_data, vs.m, vs.n, vs.mb, vs.nb, vs.grid))
     return s, Um, Vm, h
 
 
+@annotate("slate.svd_vals")
 def svd_vals(A: Matrix, opts: Options | None = None):
     """Singular values only (ref: simplified_api svd_vals).  Under
     ``ErrorPolicy.Info`` returns ``(s, HealthInfo)``."""

@@ -1,0 +1,36 @@
+"""slate_tpu.obs — the observability spine (docs/OBSERVABILITY.md).
+
+Four pieces, all host-side and zero-overhead when disabled:
+
+- structured driver events (:mod:`events`): one JSON record per public
+  driver call — op, shapes, resolved policy/speculate/abft, path taken,
+  HealthInfo counters, tuned plans, wall duration;
+- a recording span tracer (:mod:`tracer`): ``util.trace.span`` wall
+  times exported as Chrome/Perfetto trace JSON or JSONL;
+- a retrace sentinel (:mod:`sentinel`): per-signature trace counters
+  with rate-limited warnings on retrace/recompile churn;
+- metrics aggregation (:mod:`metrics`) behind the
+  ``python -m slate_tpu.obs`` CLI.
+
+The jaxpr-identity guarantee: enabling any of this changes NOTHING in
+traced computations (no io_callback, no extra ops) — recording reads
+returned HealthInfo and host clocks only.
+"""
+
+from .events import (SCHEMA, boundary_enter, boundary_exit, clear,
+                     configure, disable, enable, enabled, note_health,
+                     note_path, note_plan, note_resolved, recent,
+                     recording)
+from .metrics import render, summarize
+from .sentinel import SlateRetraceWarning
+from .sentinel import reset as reset_sentinel
+from .sentinel import stats as sentinel_stats
+from .tracer import SpanRecorder, record_spans
+
+__all__ = [
+    "SCHEMA", "SlateRetraceWarning", "SpanRecorder", "boundary_enter",
+    "boundary_exit", "clear", "configure", "disable", "enable", "enabled",
+    "note_health", "note_path", "note_plan", "note_resolved", "recent",
+    "record_spans", "recording", "render", "reset_sentinel",
+    "sentinel_stats", "summarize",
+]

@@ -1,0 +1,44 @@
+"""CLI: aggregate slate event/bench JSONL into summary tables.
+
+    python -m slate_tpu.obs events.jsonl BENCH_r07.json
+    python -m slate_tpu.obs --json events.jsonl > summary.json
+
+Accepts any mix of obs event JSONL (slate-obs-v1), span JSONL, and
+bench output (slate-bench-v1 — and pre-schema BENCH_r*.json lines),
+and prints per-op latency percentiles, escalation/ABFT/certificate
+rates, plan-usage and bench tables (see docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import metrics
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m slate_tpu.obs",
+        description="Summarize slate_tpu event/bench JSONL files.")
+    parser.add_argument("files", nargs="+",
+                        help="event JSONL and/or bench JSON-lines files")
+    parser.add_argument("--json", action="store_true",
+                        help="print the summary as JSON instead of tables")
+    args = parser.parse_args(argv)
+    try:
+        summary = metrics.summarize(args.files)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(summary, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(metrics.render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

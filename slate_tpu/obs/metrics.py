@@ -1,0 +1,227 @@
+"""Metrics aggregation: event/bench JSONL -> per-op tables.
+
+Consumes the two line formats the repo emits —
+
+- ``slate-obs-v1`` driver events (obs/events.py) and spans,
+- ``slate-bench-v1`` bench lines (bench.py; pre-schema BENCH_r*.json
+  lines are accepted too: anything with a ``metric`` key),
+
+and aggregates them into per-op latency percentiles (p50/p99 of
+``dur_ms``), escalation / ABFT / certificate-failure rates, plan-usage
+tables and a bench-round summary.  Pure stdlib; the CLI front-end is
+obs/__main__.py.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+EVENT_SCHEMA = "slate-obs-v1"
+BENCH_SCHEMA = "slate-bench-v1"
+
+
+def load_lines(paths) -> list[dict]:
+    """Parse JSONL files (or whole-file JSON arrays); non-JSON lines and
+    non-dict records are skipped, not fatal — logs interleave."""
+    out: list[dict] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        stripped = text.lstrip()
+        if stripped.startswith("["):
+            try:
+                arr = json.loads(stripped)
+            except ValueError:
+                arr = []
+            out.extend(x for x in arr if isinstance(x, dict))
+            continue
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                out.append(obj)
+    return out
+
+
+def split_records(records):
+    """(events, spans, bench, unknown) from a mixed record list."""
+    events, spans, bench, unknown = [], [], [], []
+    for r in records:
+        schema, kind = r.get("schema"), r.get("kind")
+        if schema == EVENT_SCHEMA and kind == "event":
+            events.append(r)
+        elif schema == EVENT_SCHEMA and kind == "span":
+            spans.append(r)
+        elif schema == BENCH_SCHEMA or "metric" in r:
+            bench.append(r)
+        else:
+            unknown.append(r)
+    return events, spans, bench, unknown
+
+
+def percentile(values, q: float) -> float | None:
+    """Linear-interpolated percentile of a list (q in [0, 100])."""
+    if not values:
+        return None
+    vs = sorted(values)
+    if len(vs) == 1:
+        return float(vs[0])
+    pos = (len(vs) - 1) * q / 100.0
+    lo = math.floor(pos)
+    hi = math.ceil(pos)
+    frac = pos - lo
+    return float(vs[lo] * (1.0 - frac) + vs[hi] * frac)
+
+
+def summarize_events(events) -> dict:
+    """Per-op aggregate: counts, latency percentiles, failure rates."""
+    ops: dict[str, dict] = {}
+    for e in events:
+        op = e.get("op") or "?"
+        s = ops.setdefault(op, {
+            "count": 0, "traced": 0, "errors": 0, "escalated": 0,
+            "speculated": 0, "abft_detected": 0, "abft_corrected": 0,
+            "cert_fail": 0, "unhealthy": 0, "_durs": []})
+        s["count"] += 1
+        if e.get("traced"):
+            s["traced"] += 1
+        else:
+            d = e.get("dur_ms")
+            if isinstance(d, (int, float)):
+                s["_durs"].append(float(d))
+        status = e.get("status") or "ok"
+        if status != "ok":
+            s["errors"] += 1
+        path = e.get("path") or ""
+        if path.startswith("escalated"):
+            s["escalated"] += 1
+        elif path.startswith("speculated"):
+            s["speculated"] += 1
+        h = e.get("health")
+        if isinstance(h, dict):
+            s["abft_detected"] += int(h.get("abft_detected") or 0)
+            s["abft_corrected"] += int(h.get("abft_corrected") or 0)
+            if h.get("converged") is False:
+                s["cert_fail"] += 1
+            if h.get("ok") is False:
+                s["unhealthy"] += 1
+    for s in ops.values():
+        durs = s.pop("_durs")
+        n = max(s["count"], 1)
+        s["p50_ms"] = percentile(durs, 50)
+        s["p99_ms"] = percentile(durs, 99)
+        s["escalation_rate"] = round(s["escalated"] / n, 4)
+        s["cert_fail_rate"] = round(s["cert_fail"] / n, 4)
+        s["error_rate"] = round(s["errors"] / n, 4)
+    return ops
+
+
+def summarize_plans(events) -> dict:
+    """Plan-usage table: how often each (op, kernel, nb, source) tuned
+    decision was consulted by an emitting driver call."""
+    table: dict[str, int] = {}
+    for e in events:
+        for p in e.get("plans") or []:
+            key = (f"{p.get('op')} kernel={p.get('kernel')} "
+                   f"nb={p.get('nb')} source={p.get('source')}")
+            table[key] = table.get(key, 0) + 1
+    return dict(sorted(table.items(), key=lambda kv: -kv[1]))
+
+
+def summarize_bench(bench) -> dict:
+    """Bench lines -> {metric: {value, unit, chip, ...}} plus skip/error
+    tallies (watchdog skip lines carry phase + elapsed_s)."""
+    metrics: dict[str, dict] = {}
+    skipped, errors = [], []
+    for b in bench:
+        name = b.get("metric") or "?"
+        if b.get("skipped"):
+            skipped.append({"metric": name, "reason": b.get("reason"),
+                            "phase": b.get("phase"),
+                            "elapsed_s": b.get("elapsed_s")})
+            continue
+        if b.get("error"):
+            errors.append({"metric": name, "error": b.get("error")})
+            continue
+        metrics[name] = {k: b[k] for k in
+                         ("value", "unit", "chip", "mfu", "vs_baseline",
+                          "nb", "bw", "kernel", "op", "n")
+                         if k in b and b[k] is not None}
+    return {"metrics": metrics, "skipped": skipped, "errors": errors}
+
+
+def summarize(paths) -> dict:
+    """Everything the CLI prints, as one JSON-able dict."""
+    records = load_lines(paths)
+    events, spans, bench, unknown = split_records(records)
+    return {
+        "files": [str(p) for p in paths],
+        "counts": {"events": len(events), "spans": len(spans),
+                   "bench": len(bench), "unknown": len(unknown)},
+        "ops": summarize_events(events),
+        "plans": summarize_plans(events),
+        "bench": summarize_bench(bench),
+    }
+
+
+# ------------------------------------------------------------- rendering
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3f}".rstrip("0").rstrip(".") or "0"
+    return str(v)
+
+
+def _table(headers, rows) -> str:
+    cols = [headers] + [[_fmt(c) for c in r] for r in rows]
+    widths = [max(len(row[i]) for row in cols) for i in range(len(headers))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+             "  ".join("-" * w for w in widths)]
+    for r in cols[1:]:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def render(summary: dict) -> str:
+    """Human tables for one summarize() result."""
+    parts = []
+    c = summary["counts"]
+    parts.append(f"records: {c['events']} events, {c['spans']} spans, "
+                 f"{c['bench']} bench lines"
+                 + (f", {c['unknown']} unknown" if c["unknown"] else ""))
+    if summary["ops"]:
+        rows = [[op, s["count"], s["traced"], s["p50_ms"], s["p99_ms"],
+                 s["escalation_rate"], s["cert_fail_rate"],
+                 f"{s['abft_corrected']}/{s['abft_detected']}",
+                 s["error_rate"]]
+                for op, s in sorted(summary["ops"].items())]
+        parts.append("\nper-op events\n" + _table(
+            ["op", "calls", "traced", "p50_ms", "p99_ms", "esc_rate",
+             "certfail_rate", "abft c/d", "err_rate"], rows))
+    if summary["plans"]:
+        rows = [[k, v] for k, v in summary["plans"].items()]
+        parts.append("\nplan usage\n" + _table(["plan", "calls"], rows))
+    bench = summary["bench"]
+    if bench["metrics"]:
+        rows = [[m, d.get("value"), d.get("unit"), d.get("mfu"),
+                 d.get("chip")] for m, d in sorted(bench["metrics"].items())]
+        parts.append("\nbench metrics\n" + _table(
+            ["metric", "value", "unit", "mfu", "chip"], rows))
+    if bench["skipped"]:
+        rows = [[s["metric"], s.get("phase"), s.get("elapsed_s"),
+                 s.get("reason")] for s in bench["skipped"]]
+        parts.append("\nbench skipped\n" + _table(
+            ["metric", "phase", "elapsed_s", "reason"], rows))
+    if bench["errors"]:
+        rows = [[e["metric"], e.get("error")] for e in bench["errors"]]
+        parts.append("\nbench errors\n" + _table(["metric", "error"], rows))
+    return "\n".join(parts) + "\n"

@@ -1,0 +1,93 @@
+"""Retrace/compile sentinel: count driver traces, warn on churn.
+
+Every boundary execution that happens while jax is TRACING (i.e. the
+driver is being staged into a jaxpr — each such staging is followed by
+an XLA compile for an unseen signature) is counted here per
+``(op, signature)``.  Two pathologies produce warnings, each once per
+op, rate-limited:
+
+- the SAME signature traced more than ``SLATE_OBS_RETRACE_LIMIT``
+  times (default 3): the caller is rebuilding jitted callables (new
+  lambdas/partials per call) and paying a full trace+compile every
+  time;
+- more than ``SLATE_OBS_SIGNATURE_LIMIT`` distinct signatures
+  (default 32) for one op: unbucketed dynamic shapes — every new shape
+  compiles a fresh program, the classic serving-layer latency cliff.
+
+The sentinel is always on: when nothing traces it does nothing, and its
+per-trace cost (a dict update) is noise next to the trace itself.
+Counters are process-global; :func:`reset` clears them (tests).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+
+
+class SlateRetraceWarning(UserWarning):
+    """A driver is retracing/recompiling more than expected."""
+
+
+_LOCK = threading.Lock()
+_TRACES: dict[str, dict[str, int]] = {}
+_WARNED: set[tuple[str, str]] = set()
+
+
+def _limit(env: str, default: int) -> int:
+    try:
+        return int(os.environ.get(env, "") or default)
+    except ValueError:
+        return default
+
+
+def record_trace(op: str, signature: str) -> None:
+    """Count one traced boundary execution (called by obs.events)."""
+    retrace_limit = _limit("SLATE_OBS_RETRACE_LIMIT", 3)
+    sig_limit = _limit("SLATE_OBS_SIGNATURE_LIMIT", 32)
+    with _LOCK:
+        sigs = _TRACES.setdefault(op, {})
+        sigs[signature] = count = sigs.get(signature, 0) + 1
+        nsigs = len(sigs)
+        warn_retrace = (count > retrace_limit
+                        and (op, "retrace") not in _WARNED)
+        if warn_retrace:
+            _WARNED.add((op, "retrace"))
+        warn_sigs = (nsigs > sig_limit and (op, "signatures") not in _WARNED)
+        if warn_sigs:
+            _WARNED.add((op, "signatures"))
+    if warn_retrace:
+        warnings.warn(
+            f"{op}: traced {count}x for the same signature "
+            f"[{signature}] (limit {retrace_limit}) — the caller is likely "
+            "re-jitting per call (fresh lambda/partial each time); hoist "
+            "the jitted callable. Raise SLATE_OBS_RETRACE_LIMIT to "
+            "silence.", SlateRetraceWarning, stacklevel=3)
+    if warn_sigs:
+        warnings.warn(
+            f"{op}: {nsigs} distinct trace signatures (limit {sig_limit}) "
+            "— unbucketed dynamic shapes recompile per shape; pad/bucket "
+            "inputs. Raise SLATE_OBS_SIGNATURE_LIMIT to silence.",
+            SlateRetraceWarning, stacklevel=3)
+
+
+def stats() -> dict:
+    """Per-op trace counters: total traces, distinct signatures, and the
+    hottest signature's count."""
+    with _LOCK:
+        return {
+            op: {
+                "traces": sum(sigs.values()),
+                "signatures": len(sigs),
+                "max_per_signature": max(sigs.values(), default=0),
+            }
+            for op, sigs in _TRACES.items()
+        }
+
+
+def reset() -> None:
+    """Clear counters and re-arm the once-per-op warnings (tests)."""
+    with _LOCK:
+        _TRACES.clear()
+        _WARNED.clear()

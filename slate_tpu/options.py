@@ -295,7 +295,10 @@ def resolve_speculate(opts: Options | None) -> bool:
     explicit ``Speculate.On`` — ``Auto`` currently maps to Off so the
     default solver behavior is unchanged.  Every consumer below the
     boundary receives the decision, never the knob."""
-    return get_option(opts, Option.Speculate) is Speculate.On
+    resolved = get_option(opts, Option.Speculate) is Speculate.On
+    from .obs import events as _obs_events
+    _obs_events.note_resolved("speculate", resolved)
+    return resolved
 
 
 def resolve_abft(opts: Options | None) -> bool:
@@ -304,7 +307,10 @@ def resolve_abft(opts: Options | None) -> bool:
     ``Auto`` currently maps to Off so default drivers pay zero checksum
     overhead.  Every consumer below the boundary receives the resolved
     boolean, never the knob."""
-    return get_option(opts, Option.Abft) is Abft.On
+    resolved = get_option(opts, Option.Abft) is Abft.On
+    from .obs import events as _obs_events
+    _obs_events.note_resolved("abft", resolved)
+    return resolved
 
 
 def select_gemm_method(opts: Options | None, nt: int) -> MethodGemm:

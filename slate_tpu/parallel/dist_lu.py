@@ -51,6 +51,7 @@ from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
 from ..robust import abft as _abft
 from ..robust import faults
 from ..util.compat_jax import shard_map_unchecked
+from ..util.trace import span
 from .dist_chol import superblock
 
 
@@ -146,30 +147,33 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
 
             # ---- gather + factor the panel (replicated) ----
-            gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
-            panel = gpan[k0:Nt].reshape(W, nb)   # static slice
-            # roll active rows (>= k) to the top, zero the factored tail
-            shift = (k - k0) * nb
-            panel = jnp.roll(panel, -shift, axis=0)
-            rows = jnp.arange(W)
-            panel = jnp.where((rows < (Nt - k) * nb)[:, None], panel,
-                              jnp.zeros_like(panel))
-            # ragged final tile: identity-augment its pad block (only the
-            # last panel has vk < nb, and it is then the top tile)
-            panel = panel + jnp.concatenate(
-                [jnp.diag((idx >= vk).astype(dt)),
-                 jnp.zeros((W - nb, nb), dt)], axis=0)
-            if method == "nopiv":
-                lu, perm = panel_lu_nopiv(panel)
-            elif method == "tntpiv":
-                br = max(ib, nb, (-(-panel.shape[0] // (mpt * nb))) * nb)
-                lu, perm = panel_lu_tournament(panel, block_rows=br,
-                                               arity=depth)
-            elif tau < 1.0:
-                lu, perm = panel_lu_threshold(panel, tau)
-            else:
-                lu, perm = panel_lu(panel)
-            lu = faults.maybe_corrupt("post_panel", lu)
+            with span("slate.getrf/panel"):
+                gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+                panel = gpan[k0:Nt].reshape(W, nb)   # static slice
+                # roll active rows (>= k) to the top, zero the factored
+                # tail
+                shift = (k - k0) * nb
+                panel = jnp.roll(panel, -shift, axis=0)
+                rows = jnp.arange(W)
+                panel = jnp.where((rows < (Nt - k) * nb)[:, None], panel,
+                                  jnp.zeros_like(panel))
+                # ragged final tile: identity-augment its pad block (only
+                # the last panel has vk < nb, and it is then the top tile)
+                panel = panel + jnp.concatenate(
+                    [jnp.diag((idx >= vk).astype(dt)),
+                     jnp.zeros((W - nb, nb), dt)], axis=0)
+                if method == "nopiv":
+                    lu, perm = panel_lu_nopiv(panel)
+                elif method == "tntpiv":
+                    br = max(ib, nb,
+                             (-(-panel.shape[0] // (mpt * nb))) * nb)
+                    lu, perm = panel_lu_tournament(panel, block_rows=br,
+                                                   arity=depth)
+                elif tau < 1.0:
+                    lu, perm = panel_lu_threshold(panel, tau)
+                else:
+                    lu, perm = panel_lu(panel)
+                lu = faults.maybe_corrupt("post_panel", lu)
             if abft:
                 # verify L\U against the pre-factor panel's checksums
                 # (replicated data -> replicated counters).  Rolled row
@@ -195,16 +199,17 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             # ---- batched row exchange for ALL columns (left + right +
             #      panel; panel values rewritten below) ----
             if method != "nopiv":
-                iota = jnp.arange(W)
-                displaced = lax.top_k((perm != iota).astype(jnp.int32),
-                                      nbundle)[1]
-                out_rows = displaced + k * nb
-                in_rows = perm[displaced] + k * nb
-                a_loc = _row_bundle_exchange(a_loc, out_rows, in_rows, p, r,
-                                             nbundle)
-                pw = lax.dynamic_slice(perm_g, (k * nb,), (W,))
-                perm_g = lax.dynamic_update_slice(perm_g, pw[perm],
-                                                  (k * nb,))
+                with span("slate.getrf/swap"):
+                    iota = jnp.arange(W)
+                    displaced = lax.top_k((perm != iota).astype(jnp.int32),
+                                          nbundle)[1]
+                    out_rows = displaced + k * nb
+                    in_rows = perm[displaced] + k * nb
+                    a_loc = _row_bundle_exchange(a_loc, out_rows, in_rows,
+                                                 p, r, nbundle)
+                    pw = lax.dynamic_slice(perm_g, (k * nb,), (W,))
+                    perm_g = lax.dynamic_update_slice(perm_g, pw[perm],
+                                                      (k * nb,))
 
             # ---- write the factored panel column back (owners col ck) ----
             ltiles_all = jnp.take(lut, jnp.clip(gi_all - k, 0, W0 - 1),
@@ -221,92 +226,94 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
             def tail(carry):
                 a_loc, perm_g, loc = carry
                 # ---- U12: row-k owners solve vs unit-lower L11, bcast ----
-                l11 = lut[0]
-                urow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
-                                                keepdims=False)
-                u12 = jax.vmap(lambda t: lax.linalg.triangular_solve(
-                    l11, t, left_side=True, lower=True,
-                    unit_diagonal=True))(urow)
-                gj_all = c + q * jnp.arange(ntl)
-                if abft:
-                    # R's checksums ride the SAME psum as the solved
-                    # tiles: the payload grows to [ntl, nb+1, nb+1] but
-                    # no collective round is added.  After the bcast
-                    # every rank re-verifies L11 @ U12 = R per local
-                    # column tile and repairs a single struck element.
-                    aug = jnp.zeros((ntl, nb + 1, nb + 1), dt)
-                    aug = aug.at[:, :nb, :nb].set(u12)
-                    aug = aug.at[:, :nb, nb].set(jnp.sum(urow, axis=2))
-                    aug = aug.at[:, nb, :nb].set(jnp.sum(urow, axis=1))
-                    aug = jnp.where(r == rk, aug, jnp.zeros_like(aug))
-                    aug = lax.psum(aug, AXIS_P)
-                    u12 = faults.maybe_corrupt("post_collective",
-                                               aug[:, :nb, :nb])
-                    r_row, r_col = aug[:, :nb, nb], aug[:, nb, :nb]
-                    u12, det_t, cor_t, _, _ = jax.vmap(
-                        lambda xx, rr, cc: _abft.left_product_check(
-                            l11, xx, rr, cc, unit=True,
-                            n_ctx=n))(u12, r_row, r_col)
-                    # count each global tile once: owner row rk only
-                    live = (gj_all > k) & (r == rk)
-                    det_n = jnp.sum(live & det_t, dtype=jnp.int32)
-                    cor_n = jnp.sum(live & cor_t, dtype=jnp.int32)
-                    tj_loc = jnp.argmax(live & det_t)
-                    s = jnp.where(
-                        det_n > 0,
-                        _abft.site_code(k, c + q * tj_loc),
-                        jnp.asarray(-1, jnp.int32))
-                    loc = (loc[0] + det_n, loc[1] + cor_n,
-                           jnp.where(loc[2] >= 0, loc[2], s))
-                else:
-                    u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
-                    u12 = lax.psum(u12, AXIS_P)  # all ranks, own cols
-                    u12 = faults.maybe_corrupt("post_collective", u12)
-                newrow = jnp.where((gj_all > k)[:, None, None], u12, urow)
-                row_sel = jnp.where(r == rk, newrow, urow)
-                a_loc = lax.dynamic_update_slice(
-                    a_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
+                with span("slate.getrf/trsm"):
+                    l11 = lut[0]
+                    urow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                                    keepdims=False)
+                    u12 = jax.vmap(lambda t: lax.linalg.triangular_solve(
+                        l11, t, left_side=True, lower=True,
+                        unit_diagonal=True))(urow)
+                    gj_all = c + q * jnp.arange(ntl)
+                    if abft:
+                        # R's checksums ride the SAME psum as the solved
+                        # tiles: the payload grows to [ntl, nb+1, nb+1] but
+                        # no collective round is added.  After the bcast
+                        # every rank re-verifies L11 @ U12 = R per local
+                        # column tile and repairs a single struck element.
+                        aug = jnp.zeros((ntl, nb + 1, nb + 1), dt)
+                        aug = aug.at[:, :nb, :nb].set(u12)
+                        aug = aug.at[:, :nb, nb].set(jnp.sum(urow, axis=2))
+                        aug = aug.at[:, nb, :nb].set(jnp.sum(urow, axis=1))
+                        aug = jnp.where(r == rk, aug, jnp.zeros_like(aug))
+                        aug = lax.psum(aug, AXIS_P)
+                        u12 = faults.maybe_corrupt("post_collective",
+                                                   aug[:, :nb, :nb])
+                        r_row, r_col = aug[:, :nb, nb], aug[:, nb, :nb]
+                        u12, det_t, cor_t, _, _ = jax.vmap(
+                            lambda xx, rr, cc: _abft.left_product_check(
+                                l11, xx, rr, cc, unit=True,
+                                n_ctx=n))(u12, r_row, r_col)
+                        # count each global tile once: owner row rk only
+                        live = (gj_all > k) & (r == rk)
+                        det_n = jnp.sum(live & det_t, dtype=jnp.int32)
+                        cor_n = jnp.sum(live & cor_t, dtype=jnp.int32)
+                        tj_loc = jnp.argmax(live & det_t)
+                        s = jnp.where(
+                            det_n > 0,
+                            _abft.site_code(k, c + q * tj_loc),
+                            jnp.asarray(-1, jnp.int32))
+                        loc = (loc[0] + det_n, loc[1] + cor_n,
+                               jnp.where(loc[2] >= 0, loc[2], s))
+                    else:
+                        u12 = jnp.where(r == rk, u12, jnp.zeros_like(u12))
+                        u12 = lax.psum(u12, AXIS_P)  # all ranks, own cols
+                        u12 = faults.maybe_corrupt("post_collective", u12)
+                    newrow = jnp.where((gj_all > k)[:, None, None], u12, urow)
+                    row_sel = jnp.where(r == rk, newrow, urow)
+                    a_loc = lax.dynamic_update_slice(
+                        a_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
 
                 # ---- trailing update on the static-size slice ----
-                sr = jnp.clip(-(-(k0 + 1 - r) // p), 0,
-                              mtl - S).astype(jnp.int32)
-                sc = jnp.clip(-(-(k0 + 1 - c) // q), 0,
-                              ntl - T).astype(jnp.int32)
-                gi = r + p * (sr + jnp.arange(S))
-                gj = c + q * (sc + jnp.arange(T))
-                lrows = jnp.take(lut, jnp.clip(gi - k, 0, W0 - 1), axis=0)
-                lrows = jnp.where((gi > k)[:, None, None], lrows,
-                                  jnp.zeros_like(lrows))
-                ucols = lax.dynamic_slice(u12, (sc, zi, zi), (T, nb, nb))
-                ucols = jnp.where((gj > k)[:, None, None], ucols,
-                                  jnp.zeros_like(ucols))
-                upd = jnp.einsum("iab,jbc->ijac", lrows, ucols,
-                                 preferred_element_type=dt)
-                cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
-                                        (S, T, nb, nb))
-                mask = ((gi > k)[:, None, None, None] &
-                        (gj > k)[None, :, None, None])
-                new = cur - upd
-                if abft:
-                    # per-tile checksum maintenance of the rank-local
-                    # GEMM (masked-out tiles have lrows/ucols zeroed, so
-                    # their expectation collapses to cur's own sums and
-                    # they verify clean by construction)
-                    exp_r = (jnp.sum(cur, axis=3)
-                             - _abft.tile_product_row_sums(
-                                 lrows[:, None], ucols[None]))
-                    exp_c = (jnp.sum(cur, axis=2)
-                             - _abft.tile_product_col_sums(
-                                 lrows[:, None], ucols[None]))
-                    new, ev, ti_l, tj_l = _abft.tile_sum_check(
-                        new, exp_r, exp_c, n_ctx=n)
-                    s = jnp.where(ev.detected > 0,
-                                  _abft.site_code(gi[ti_l], gj[tj_l]),
-                                  jnp.asarray(-1, jnp.int32))
-                    loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
-                           jnp.where(loc[2] >= 0, loc[2], s))
-                a_loc = lax.dynamic_update_slice(
-                    a_loc, jnp.where(mask, new, cur), (sr, sc, zi, zi))
+                with span("slate.getrf/gemm"):
+                    sr = jnp.clip(-(-(k0 + 1 - r) // p), 0,
+                                  mtl - S).astype(jnp.int32)
+                    sc = jnp.clip(-(-(k0 + 1 - c) // q), 0,
+                                  ntl - T).astype(jnp.int32)
+                    gi = r + p * (sr + jnp.arange(S))
+                    gj = c + q * (sc + jnp.arange(T))
+                    lrows = jnp.take(lut, jnp.clip(gi - k, 0, W0 - 1), axis=0)
+                    lrows = jnp.where((gi > k)[:, None, None], lrows,
+                                      jnp.zeros_like(lrows))
+                    ucols = lax.dynamic_slice(u12, (sc, zi, zi), (T, nb, nb))
+                    ucols = jnp.where((gj > k)[:, None, None], ucols,
+                                      jnp.zeros_like(ucols))
+                    upd = jnp.einsum("iab,jbc->ijac", lrows, ucols,
+                                     preferred_element_type=dt)
+                    cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
+                                            (S, T, nb, nb))
+                    mask = ((gi > k)[:, None, None, None] &
+                            (gj > k)[None, :, None, None])
+                    new = cur - upd
+                    if abft:
+                        # per-tile checksum maintenance of the rank-local
+                        # GEMM (masked-out tiles have lrows/ucols zeroed, so
+                        # their expectation collapses to cur's own sums and
+                        # they verify clean by construction)
+                        exp_r = (jnp.sum(cur, axis=3)
+                                 - _abft.tile_product_row_sums(
+                                     lrows[:, None], ucols[None]))
+                        exp_c = (jnp.sum(cur, axis=2)
+                                 - _abft.tile_product_col_sums(
+                                     lrows[:, None], ucols[None]))
+                        new, ev, ti_l, tj_l = _abft.tile_sum_check(
+                            new, exp_r, exp_c, n_ctx=n)
+                        s = jnp.where(ev.detected > 0,
+                                      _abft.site_code(gi[ti_l], gj[tj_l]),
+                                      jnp.asarray(-1, jnp.int32))
+                        loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
+                               jnp.where(loc[2] >= 0, loc[2], s))
+                    a_loc = lax.dynamic_update_slice(
+                        a_loc, jnp.where(mask, new, cur), (sr, sc, zi, zi))
                 return a_loc, perm_g, loc
 
             if S > 0 and T > 0:

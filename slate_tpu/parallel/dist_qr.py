@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..comm.collectives import bcast_along
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
+from ..util.trace import span
 from ..util.compat_jax import pvary, shard_map_unchecked
 from ..internal.qr import (build_t, geqrf_panel, householder_panel,
                            unit_lower)
@@ -146,54 +147,58 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
         posr = poss[k, r]
 
         # ---- local panel QR on my rolled rows of tile-column k ----
-        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
-        pan0 = pan
-        pan = jnp.where((gi_all >= k)[:, None, None], pan,
-                        jnp.zeros_like(pan))
-        pan = jnp.roll(pan, -skip, axis=0)
-        slab = pan.reshape(mtl * nb, nb)
-        packed, Tr = geqrf_panel(slab)   # tuned: Pallas panel or XLA
-        # only the owner column's panel is real; share it across the row
-        packed = bcast_along(jnp.where(c == ck, packed,
-                                       jnp.zeros_like(packed)), ck, AXIS_Q)
-        Tr = bcast_along(jnp.where(c == ck, Tr, jnp.zeros_like(Tr)),
-                         ck, AXIS_Q)
-        Vr = unit_lower(packed)
-        Tloc = Tloc.at[k].set(Tr)
+        with span("slate.geqrf/panel"):
+            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+            pan0 = pan
+            pan = jnp.where((gi_all >= k)[:, None, None], pan,
+                            jnp.zeros_like(pan))
+            pan = jnp.roll(pan, -skip, axis=0)
+            slab = pan.reshape(mtl * nb, nb)
+            packed, Tr = geqrf_panel(slab)   # tuned: Pallas panel or XLA
+            # only the owner column's panel is real; share it across the row
+            packed = bcast_along(jnp.where(c == ck, packed,
+                                           jnp.zeros_like(packed)), ck, AXIS_Q)
+            Tr = bcast_along(jnp.where(c == ck, Tr, jnp.zeros_like(Tr)),
+                             ck, AXIS_Q)
+            Vr = unit_lower(packed)
+            Tloc = Tloc.at[k].set(Tr)
 
         # ---- R-stack tree: gather nb x nb R factors, factor replicated ----
-        Rr = jnp.triu(packed[:nb])
-        buf = jnp.zeros((p * nb, nb), dt).at[posr].set(Rr)
-        stack = lax.psum(buf, AXIS_P)
-        packed_s, taus_s = householder_panel(stack)
-        Ts = build_t(packed_s, taus_s)
-        Vs = unit_lower(packed_s)
-        Vs_mine = Vs[posr]                       # my slot rows [nb, nb]
-        Rfin = jnp.triu(packed_s[:nb])
-        Vtree = Vtree.at[k].set(Vs)
-        Ttree = Ttree.at[k].set(Ts)
+        with span("slate.geqrf/tree"):
+            Rr = jnp.triu(packed[:nb])
+            buf = jnp.zeros((p * nb, nb), dt).at[posr].set(Rr)
+            stack = lax.psum(buf, AXIS_P)
+            packed_s, taus_s = householder_panel(stack)
+            Ts = build_t(packed_s, taus_s)
+            Vs = unit_lower(packed_s)
+            Vs_mine = Vs[posr]                       # my slot rows [nb, nb]
+            Rfin = jnp.triu(packed_s[:nb])
+            Vtree = Vtree.at[k].set(Vs)
+            Ttree = Ttree.at[k].set(Ts)
 
         # ---- write back V (head tile: strict lower; diag tile adds R) ----
-        head = jnp.tril(packed[:nb], -1)
-        head = jnp.where(r == rk, head + Rfin, head)
-        vstore = packed.at[:nb].set(head)
-        vtiles = _rows_unview(vstore, skip, mtl, 1, nb)[:, 0]
-        newcol = jnp.where((gi_all >= k)[:, None, None], vtiles, pan0)
-        col_sel = jnp.where(c == ck, newcol, pan0)
-        zi = jnp.zeros((), jnp.int32)
-        a_loc = lax.dynamic_update_slice(
-            a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+        with span("slate.geqrf/writeback"):
+            head = jnp.tril(packed[:nb], -1)
+            head = jnp.where(r == rk, head + Rfin, head)
+            vstore = packed.at[:nb].set(head)
+            vtiles = _rows_unview(vstore, skip, mtl, 1, nb)[:, 0]
+            newcol = jnp.where((gi_all >= k)[:, None, None], vtiles, pan0)
+            col_sel = jnp.where(c == ck, newcol, pan0)
+            zi = jnp.zeros((), jnp.int32)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
 
         # ---- trailing update: Q^H on columns gj > k (one psum for tree) ----
-        Cl = _rows_view(a_loc, skip)             # [mtl*nb, ntl*nb]
-        colmask = jnp.repeat(gj_all > k, nb)[None, :]
-        Cm = jnp.where(colmask, Cl, jnp.zeros_like(Cl))
-        Cm = _panel_apply(Cm, Vr, Tr, Vs_mine, Ts, conj_trans=True)
-        Cl = jnp.where(colmask, Cm, Cl)
-        newt = _rows_unview(Cl, skip, mtl, ntl, nb)
-        rowmask = (gi_all >= k)[:, None, None, None]
-        cmask = (gj_all > k)[None, :, None, None]
-        a_loc = jnp.where(rowmask & cmask, newt, a_loc)
+        with span("slate.geqrf/update"):
+            Cl = _rows_view(a_loc, skip)             # [mtl*nb, ntl*nb]
+            colmask = jnp.repeat(gj_all > k, nb)[None, :]
+            Cm = jnp.where(colmask, Cl, jnp.zeros_like(Cl))
+            Cm = _panel_apply(Cm, Vr, Tr, Vs_mine, Ts, conj_trans=True)
+            Cl = jnp.where(colmask, Cm, Cl)
+            newt = _rows_unview(Cl, skip, mtl, ntl, nb)
+            rowmask = (gi_all >= k)[:, None, None, None]
+            cmask = (gj_all > k)[None, :, None, None]
+            a_loc = jnp.where(rowmask & cmask, newt, a_loc)
         return a_loc, Tloc, Vtree, Ttree
 
     return lax.fori_loop(0, Kt, step, (a_loc, Tloc0, Vtree0, Ttree0))

@@ -30,6 +30,7 @@ from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.gemm import tile_outer_product
 from ..robust import abft as _abft
 from ..robust import faults
+from ..util.trace import span
 
 
 def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int,
@@ -50,16 +51,20 @@ def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int,
     """
 
     def step(k):
-        a_col = lax.dynamic_index_in_dim(a_loc, k // q, axis=1, keepdims=False)
-        a_col = bcast_from_col(a_col, k % q)
-        b_row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0, keepdims=False)
-        b_row = bcast_from_row(b_row, k % p)
+        with span("slate.gemm/bcast"):
+            a_col = lax.dynamic_index_in_dim(a_loc, k // q, axis=1,
+                                             keepdims=False)
+            a_col = bcast_from_col(a_col, k % q)
+            b_row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0,
+                                             keepdims=False)
+            b_row = bcast_from_row(b_row, k % p)
         return a_col, b_row
 
     if not abft:
         def body(k, acc):
             a_col, b_row = step(k)
-            return acc + tile_outer_product(a_col, b_row)
+            with span("slate.gemm/accumulate"):
+                return acc + tile_outer_product(a_col, b_row)
 
         acc = lax.fori_loop(0, Kt, body, jnp.zeros_like(c_loc))
         acc = faults.maybe_corrupt("post_collective", acc)
@@ -72,13 +77,14 @@ def summa_local(a_loc, b_loc, c_loc, alpha, beta, Kt: int, p: int, q: int,
     def body(k, carry):
         acc, rexp, cexp = carry
         a_col, b_row = step(k)
-        acc = acc + tile_outer_product(a_col, b_row)
-        # checksum maintenance without forming the product:
-        # A (B e) and (e^T A) B per tile pair, O(tiles * nb^2)
-        rexp = rexp + _abft.tile_product_row_sums(a_col[:, None],
-                                                  b_row[None])
-        cexp = cexp + _abft.tile_product_col_sums(a_col[:, None],
-                                                  b_row[None])
+        with span("slate.gemm/accumulate"):
+            acc = acc + tile_outer_product(a_col, b_row)
+            # checksum maintenance without forming the product:
+            # A (B e) and (e^T A) B per tile pair, O(tiles * nb^2)
+            rexp = rexp + _abft.tile_product_row_sums(a_col[:, None],
+                                                      b_row[None])
+            cexp = cexp + _abft.tile_product_col_sums(a_col[:, None],
+                                                      b_row[None])
         return acc, rexp, cexp
 
     acc, rexp, cexp = lax.fori_loop(

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 from ..exceptions import (SlateNotConvergedError,
                           SlateNotPositiveDefiniteError, SlateSingularError)
+from ..obs import events as _obs
 from ..options import (ErrorPolicy, MethodEig, MethodGels, MethodLU,
                        MethodSvd, Option, Options, get_option, resolve_abft,
                        resolve_speculate, select_gels_method,
@@ -172,12 +173,15 @@ def gesv_with_recovery(A, B, opts: Options | None = None):
     retry_same = [same] if (abft and fb_methods) else []
     # bounded_retry demotes `converged` on growth beyond the limit: the raw
     # drivers keep growth out of .ok, the recovering solver does not.
-    (F, X), h, _ = bounded_retry(
+    (F, X), h, used = bounded_retry(
         first,
         retry_same + [lambda m=m: _lu_attempt(A, B, opts, m)
                       for m in fb_methods],
         dtype=A.dtype,
         max_retries=max(len(fb_methods) + len(retry_same), 1))
+    _obs.note_path("rbt" if speculate else chain[0].name,
+                   (["retry_same"] if retry_same else [])
+                   + [m.name for m in fb_methods], used, speculate)
     return _finalize_solve("gesv", F, X, h, opts, _singular_exc("gesv"))
 
 
@@ -186,6 +190,7 @@ def gesv_nopiv_raw(A, B, opts: Options | None = None):
     demotion — the historical contract is that a finite (if catastrophic)
     NoPiv solve returns rather than raises."""
     (F, X), h = _lu_attempt(A, B, opts, MethodLU.NoPiv)
+    _obs.note_path("NoPiv", (), 0, False)
     return _finalize_solve("gesv_nopiv", F, X, h, opts,
                            _singular_exc("gesv_nopiv"))
 
@@ -220,14 +225,17 @@ def posv_with_recovery(A, B, opts: Options | None = None):
     The first returned element is the factor object of whichever method
     succeeded (TriangularMatrix / HEFactors / LUFactors)."""
     first = _chol_attempt(A, B, opts)
-    fallbacks = []
+    fallbacks, rungs = [], []
     if get_option(opts, Option.UseFallbackSolver):
         fallbacks = [lambda: _hesv_attempt(A, B, opts),
                      lambda: _gesv_attempt(A, B, opts)]
+        rungs = ["hesv", "gesv"]
         if resolve_abft(opts):  # the one Option.Abft read here
             fallbacks.insert(0, lambda: _chol_attempt(A, B, opts))
-    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype,
-                                 max_retries=max(len(fallbacks), 2))
+            rungs.insert(0, "retry_same")
+    (F, X), h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
+                                    max_retries=max(len(fallbacks), 2))
+    _obs.note_path("cholesky", rungs, used, False)
     return _finalize_solve(
         "posv", F, X, h, opts,
         lambda hh: SlateNotPositiveDefiniteError(
@@ -298,10 +306,11 @@ def heev_with_recovery(A, opts: Options | None = None, *, jobz: bool = True):
     def attempt(m):
         return _heev.heev_info(A, _with(opts, MethodEig=m), jobz=jobz)
 
-    (w, Z), h, _ = bounded_retry(
+    (w, Z), h, used = bounded_retry(
         attempt(chain[0]),
         [lambda m=m: attempt(m) for m in chain[1:]],
         dtype=A.dtype, max_retries=len(chain))
+    _obs.note_path(chain[0].name, [m.name for m in chain[1:]], used, False)
     return _h.finalize_flat("heev", (w, Z), h, opts,
                             _notconverged_exc("heev"))
 
@@ -319,10 +328,11 @@ def svd_with_recovery(A, opts: Options | None = None, *, jobu: bool = True):
     def attempt(m):
         return _svd.svd_info(A, _with(opts, MethodSvd=m), jobu=jobu)
 
-    (s, U, V), h, _ = bounded_retry(
+    (s, U, V), h, used = bounded_retry(
         attempt(chain[0]),
         [lambda m=m: attempt(m) for m in chain[1:]],
         dtype=A.dtype, max_retries=len(chain))
+    _obs.note_path(chain[0].name, [m.name for m in chain[1:]], used, False)
     return _h.finalize_flat("svd", (s, U, V), h, opts,
                             _notconverged_exc("svd"))
 
@@ -354,16 +364,20 @@ def hesv_with_recovery(A, B, opts: Options | None = None):
         return (F, X), _h.merge(fh, _h.from_result(X.storage.data))
 
     use_fb = get_option(opts, Option.UseFallbackSolver)
-    if resolve_speculate(opts):
-        first = _chol_attempt(A, B, opts)
-        fallbacks = [aasen]
+    speculate = resolve_speculate(opts)
+    if speculate:
+        first_name, first = "cholesky", _chol_attempt(A, B, opts)
+        fallbacks, rungs = [aasen], ["aasen"]
         if use_fb:
             fallbacks.append(lambda: _gesv_attempt(A, B, opts))
+            rungs.append("gesv")
     else:
-        first = aasen()
+        first_name, first = "aasen", aasen()
         fallbacks = [lambda: _gesv_attempt(A, B, opts)] if use_fb else []
-    (F, X), h, _ = bounded_retry(first, fallbacks, dtype=A.dtype,
-                                 max_retries=max(len(fallbacks), 1))
+        rungs = ["gesv"] if use_fb else []
+    (F, X), h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
+                                    max_retries=max(len(fallbacks), 1))
+    _obs.note_path(first_name, rungs, used, speculate)
     return _finalize_solve("hesv", F, X, h, opts, _singular_exc("hesv"))
 
 
@@ -387,15 +401,20 @@ def gels_with_recovery(A, B, opts: Options | None = None):
     speculate = resolve_speculate(opts)
     method = select_gels_method(opts, A.m, A.n)
     if speculate:
+        first_name = "cholqr2"
         first = _qr._gels_cholqr_attempt(A, B, opts, refine=1, certify=True)
     elif method is MethodGels.CholQR:
+        first_name = "cholqr"
         first = _qr._gels_cholqr_attempt(A, B, opts)
     else:
+        _obs.note_path("qr", (), 0, False)
         return _qr.gels_qr(A, B, opts)
     fallbacks = []
     if get_option(opts, Option.UseFallbackSolver):
         fallbacks = [lambda: _qr._gels_qr_attempt(A, B, opts)]
-    X, h, _ = bounded_retry(first, fallbacks, dtype=A.dtype, max_retries=1)
+    X, h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
+                               max_retries=1)
+    _obs.note_path(first_name, ["qr"] if fallbacks else [], used, speculate)
     return _h.finalize("gels", X, h, opts, _qr._gram_exc("gels"))
 
 

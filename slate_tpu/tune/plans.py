@@ -25,10 +25,9 @@ Cache schema (version 1)::
      "chips": {"<chip-kind>": {"<op>": {"n=512,dtype=float32":
          {"kernel": "pallas", "nb": 512, "bw": 8, "gflops": 123.4}}}}}
 
-``SLATE_PALLAS`` is DEPRECATED (one release): it is honored as a
-force-on ("1") / force-off ("0") override of the resolved plan and
-warns once per process.  Use the plan cache (or ``plan_override`` in
-tests) instead.
+``SLATE_PALLAS`` is REMOVED (deprecated in the previous release): the
+variable is IGNORED and setting it warns once per process, pointing at
+``plan_override`` and the ``python -m slate_tpu.tune`` CLI.
 """
 
 from __future__ import annotations
@@ -230,24 +229,23 @@ def plan_override(op: str, plan: TilePlan):
             _OVERRIDES[op] = prev
 
 
-def _forced() -> bool | None:
-    """DEPRECATED SLATE_PALLAS override: '1' force-pallas, '0'/''
-    force-xla, unset no opinion."""
+def _warn_removed_env() -> None:
+    """SLATE_PALLAS is REMOVED: warn once per process that the variable
+    is ignored, pointing at the supported seams."""
     global _WARNED
-    val = os.environ.get("SLATE_PALLAS")
-    if val is None:
-        return None
-    if not _WARNED:
-        _WARNED = True
-        warnings.warn(
-            "SLATE_PALLAS is deprecated and will be removed next release; "
-            "plans now come from the autotuner cache (see docs/TUNING.md). "
-            "It is honored this release as a force-on/off override.",
-            DeprecationWarning, stacklevel=3)
-    return val == "1"
+    if _WARNED or os.environ.get("SLATE_PALLAS") is None:
+        return
+    _WARNED = True
+    warnings.warn(
+        "SLATE_PALLAS has been removed and is IGNORED; kernel selection "
+        "comes from the autotuner plan cache. Use plan_override() in "
+        "tests or tune plans with `python -m slate_tpu.tune` "
+        "(see docs/TUNING.md).", stacklevel=3)
 
 
-def _lookup(op: str, n: int, dtype: str) -> TilePlan | None:
+def _lookup(op: str, n: int, dtype: str):
+    """Nearest tuned plan by |log2(n/n')|, same dtype only.  Returns
+    ``(TilePlan, dist)`` — dist 0.0 is an exact size hit — or None."""
     entries = _cached().get("chips", {}).get(chip_kind(), {}).get(op)
     if not entries:
         return None
@@ -262,7 +260,7 @@ def _lookup(op: str, n: int, dtype: str) -> TilePlan | None:
     if best_key is None:
         return None
     ent = entries[best_key]
-    return TilePlan(ent["kernel"], int(ent["nb"]), int(ent["bw"]))
+    return TilePlan(ent["kernel"], int(ent["nb"]), int(ent["bw"])), best_dist
 
 
 def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
@@ -270,18 +268,23 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
     ``TilePlan`` for ``op`` at problem size ``n`` (nearest tuned size
     for this chip kind wins; exact match preferred).  Arguments must be
     host-static (shape ints / dtype names) — the result is static
-    configuration, safe inside jit-traced drivers."""
+    configuration, safe inside jit-traced drivers.  Each resolution is
+    noted into the open obs event frame (cache hit vs nearest-n
+    distance), so production events audit plan usage."""
+    from ..obs import events as _obs
     if op not in OPS:
         raise ValueError(f"unknown op {op!r} (known: {OPS})")
+    _warn_removed_env()
     ov = _OVERRIDES.get(op)
     if ov is not None:
+        _obs.note_plan(op, int(n), dtype, ov.kernel, ov.nb,
+                       "override", None)
         return ov
-    force = _forced()
-    if force is False:
-        return XLA_PLAN
-    plan = _lookup(op, int(n), dtype)
-    if force:
-        base = plan if plan is not None and plan.kernel == "pallas" \
-            else TilePlan("pallas", min(max(int(n), 128), 512), 8)
-        return base
-    return plan or XLA_PLAN
+    found = _lookup(op, int(n), dtype)
+    if found is None:
+        plan, source, dist = XLA_PLAN, "default", None
+    else:
+        plan, dist = found
+        source = "exact" if dist == 0.0 else "nearest"
+    _obs.note_plan(op, int(n), dtype, plan.kernel, plan.nb, source, dist)
+    return plan

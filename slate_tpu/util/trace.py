@@ -1,4 +1,4 @@
-"""Tracing: the label-every-op discipline.
+"""Tracing: the label-every-op discipline, now with optional recording.
 
 Analog of the reference's trace::Block RAII instrumentation (ref:
 include/slate/internal/Trace.hh:103-110 — every kernel, MPI call and
@@ -10,6 +10,17 @@ profiler TraceAnnotation (visible on the host timeline) and a
 jax.named_scope (labels the emitted XLA ops, so device-side kernels in a
 profile carry driver/phase names like ``slate.potrf/panel``).
 
+Two observability layers ride on the same names (slate_tpu/obs,
+docs/OBSERVABILITY.md), both zero-overhead when inactive and both
+host-side only — the traced computation is byte-identical either way:
+
+- inside ``obs.record_spans()`` every span's wall time is recorded for
+  Chrome/Perfetto export (the trace::Block timeline, kept this time);
+- :func:`annotate` additionally opens a driver *boundary* for the
+  structured-event layer: one event per public driver call, fed by the
+  health/recovery/tune seams, and the retrace sentinel counts traced
+  executions per signature.
+
 Capture a profile the standard jax way::
 
     with jax.profiler.trace("/tmp/jax-trace"):
@@ -20,8 +31,12 @@ Capture a profile the standard jax way::
 from __future__ import annotations
 
 import contextlib
+import functools
 
 import jax
+
+from ..obs import events as _events
+from ..obs import tracer as _tracer
 
 
 @contextlib.contextmanager
@@ -29,20 +44,34 @@ def span(name: str):
     """Named block around driver/kernel phases (trace::Block analog).
 
     Safe both outside jit (host annotation) and while tracing (XLA op
-    names)."""
-    with jax.profiler.TraceAnnotation(name):
-        with jax.named_scope(name):
-            yield
+    names).  Records wall times when an obs.record_spans() recorder is
+    active on this thread."""
+    rec = _tracer.active()
+    tok = rec.enter(name) if rec is not None else None
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            with jax.named_scope(name):
+                yield
+    finally:
+        if rec is not None:
+            rec.exit(tok)
 
 
 def annotate(name: str):
-    """Decorator form of :func:`span` for whole drivers."""
+    """Decorator form of :func:`span` for whole drivers — also the
+    structured-event boundary: one obs event per outermost call."""
     def deco(fn):
-        import functools
-
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with span(name):
-                return fn(*args, **kwargs)
+            tok = _events.boundary_enter(name, args)
+            try:
+                with span(name):
+                    out = fn(*args, **kwargs)
+            except BaseException as e:
+                _events.boundary_exit(tok, error=e)
+                # slate-lint: disable=TRC006 -- bare re-raise after noting
+                raise
+            _events.boundary_exit(tok)
+            return out
         return wrapper
     return deco

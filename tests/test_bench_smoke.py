@@ -56,7 +56,9 @@ def test_metric_emits_json(bench, capsys, name, kwargs):
     lines = _lines(capsys)
     assert len(lines) == 1
     line = lines[0]
+    assert line["schema"] == "slate-bench-v1"
     assert line["unit"] == "GFLOP/s"
+    assert "chip" in line
     assert isinstance(line["value"], (int, float)) and line["value"] > 0
     assert isinstance(line["vs_baseline"], (int, float))
     if "abft" in name:
@@ -95,6 +97,10 @@ def test_budget_preempts_slow_metric(bench, capsys):
     assert lines[0]["skipped"] is True
     assert lines[0]["metric"] == "sleepy_skipped"
     assert "preempted" in lines[0]["reason"]
+    # triage fields: which phase it died in and how long it got
+    assert lines[0]["schema"] == "slate-bench-v1"
+    assert lines[0]["phase"] == "compile"
+    assert lines[0]["elapsed_s"] >= 0.3
 
 
 def test_budget_skips_up_front(bench, capsys, monkeypatch):
@@ -125,6 +131,7 @@ def test_budget_skips_up_front(bench, capsys, monkeypatch):
     assert lines[0]["skipped"] is True
     assert lines[0]["metric"] == "never_skipped"
     assert lines[0]["reason"] == "time budget exhausted"
+    assert lines[0]["schema"] == "slate-bench-v1" and "chip" in lines[0]
 
 
 def test_no_budget_is_unlimited(bench, capsys):
@@ -229,6 +236,7 @@ def test_sweep_nb_mode_emits_candidate_lines(bench, capsys, monkeypatch):
     from slate_tpu.tune import OPS
     assert len(lines) == 2 * len(OPS)
     for line in lines:
+        assert line["schema"] == "slate-bench-v1"
         assert line["metric"].startswith("sweep_")
         assert line["kernel"] in ("xla", "pallas")
         assert isinstance(line["nb"], int) and isinstance(line["bw"], int)

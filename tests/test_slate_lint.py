@@ -791,9 +791,61 @@ def test_seam011_silent_inside_tune_and_via_resolver(tmp_path):
     assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
 
 
+# --------------------------------------------------------------------------
+# observability pack (OBS001)
+
+
+def test_obs001_fires_on_adhoc_telemetry(tmp_path):
+    """print / logging / io_callback in drivers, internal, or parallel
+    modules bypass the obs spine and fire OBS001."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/drivers/qr.py": (
+            "def qr(a, opts=None):\n"
+            "    print('factoring', a)\n"
+            "    return a\n"),
+        "slate_tpu/internal/gemm.py": (
+            "import logging\n\n"
+            "log = logging.getLogger(__name__)\n\n\n"
+            "def gemm(a, b):\n"
+            "    log.info('gemm')\n"
+            "    return a\n"),
+        "slate_tpu/parallel/dist_lu.py": (
+            "from jax.experimental import io_callback\n\n\n"
+            "def dist_getrf(a):\n"
+            "    io_callback(lambda x: x, None, a)\n"
+            "    return a\n"),
+    })
+    fs = lint(root, {"OBS001"})
+    assert rule_ids(fs) == {"OBS001"}
+    paths = {f.path for f in fs}
+    assert paths == {"slate_tpu/drivers/qr.py",
+                     "slate_tpu/internal/gemm.py",
+                     "slate_tpu/parallel/dist_lu.py"}
+
+
+def test_obs001_silent_on_obs_spine_and_printing(tmp_path):
+    """The sanctioned telemetry routes stay silent: annotate/span from
+    util.trace, and drivers/printing.py (stdout IS its contract)."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/drivers/qr.py": (
+            "from ..util.trace import annotate, span\n\n\n"
+            "@annotate('slate.geqrf')\n"
+            "def geqrf(a, opts=None):\n"
+            "    with span('slate.geqrf/panel'):\n"
+            "        return a\n"),
+        "slate_tpu/drivers/printing.py": (
+            "def pprint(a):\n"
+            "    print(a)\n"),
+        "slate_tpu/obs/events.py": (
+            "def emit(line):\n"
+            "    print(line)\n"),
+    })
+    assert lint(root, {"OBS001"}) == []
+
+
 def test_registry_has_required_rule_surface():
     assert len(REGISTRY) >= 14
-    packs = {"TRC", "COL", "SEAM"}
+    packs = {"TRC", "COL", "SEAM", "OBS"}
     assert {r[:3] if not r.startswith("SEAM") else "SEAM"
             for r in REGISTRY} == packs
 

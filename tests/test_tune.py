@@ -1,7 +1,7 @@
 """Autotuner plan store tests (slate_tpu/tune/): schema validation, the
 record -> persist -> reload -> resolve round trip (including under jit,
 where the resolved plan must lower to a pallas_call), nearest-n lookup,
-the plan_override test seam, and the SLATE_PALLAS deprecation shim."""
+the plan_override test seam, and the SLATE_PALLAS removal warning."""
 
 import json
 import warnings
@@ -144,20 +144,21 @@ def test_plan_override_scopes_and_restores(cache):
             pass
 
 
-def test_slate_pallas_env_is_deprecated_but_honored(cache, monkeypatch):
+def test_slate_pallas_env_is_removed_and_ignored(cache, monkeypatch):
+    """SLATE_PALLAS no longer forces kernel routes: setting it warns once
+    (pointing at plan_override / the tuner) and has NO effect on
+    resolution in either direction."""
     monkeypatch.setenv("SLATE_PALLAS", "1")
     monkeypatch.setattr(tune.plans, "_WARNED", False)
-    with pytest.warns(DeprecationWarning, match="SLATE_PALLAS is "
-                      "deprecated"):
+    with pytest.warns(UserWarning, match="SLATE_PALLAS has been removed"):
         plan = resolve_plan("potrf_tile", 256)
-    assert plan.kernel == "pallas"                  # force-on fallback
-    # force-off beats a cached pallas plan
+    assert plan == XLA_PLAN                  # no force-on: untuned -> XLA
+    # nor does force-off beat a cached pallas plan
     record_plan("potrf_tile", 256, "float32",
                 TilePlan(kernel="pallas", nb=256, bw=8))
     monkeypatch.setenv("SLATE_PALLAS", "0")
-    assert resolve_plan("potrf_tile", 256) == XLA_PLAN
-    # the warning fires once per process
-    monkeypatch.setattr(tune.plans, "_WARNED", True)
+    assert resolve_plan("potrf_tile", 256).kernel == "pallas"
+    # the warning fired once per process: silent from here on
     with warnings.catch_warnings():
         warnings.simplefilter("error")
         resolve_plan("potrf_tile", 256)

@@ -1,0 +1,94 @@
+"""Observability rules (OBS0xx).
+
+OBS001 — drivers, internal kernels, and parallel kernels do NOT emit
+ad-hoc telemetry: no ``print``, no ``logging`` module, no
+``io_callback``/``jax.debug.print``/``jax.debug.callback``.  The repo's
+telemetry has exactly one spine (``slate_tpu/obs``): driver boundaries
+emit structured events through ``util.trace.annotate`` and phases are
+marked with ``util.trace.span`` — both host-side and zero-overhead when
+disabled.  A stray ``print`` is invisible to the metrics CLI, and a
+traced-side ``io_callback`` changes the jaxpr (breaking the
+jaxpr-identity guarantee tests/test_obs.py enforces).
+
+``drivers/printing.py`` is exempt: pretty-printing matrices to stdout is
+its entire contract.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..model import Finding, Rule, register
+
+#: directories whose modules must stay telemetry-clean
+CHECKED_PREFIXES = ("slate_tpu/drivers/", "slate_tpu/internal/",
+                    "slate_tpu/parallel/")
+#: stdout IS the contract here
+EXEMPT_FILES = {"slate_tpu/drivers/printing.py"}
+
+#: call / import names that bypass the obs spine
+BANNED_CALLS = {"print", "io_callback", "pure_callback", "debug_print"}
+BANNED_MODULES = {"logging"}
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        # jax.debug.print / jax.debug.callback / jax.experimental.io_callback
+        if f.attr in ("print", "callback"):
+            base = f.value
+            if isinstance(base, ast.Attribute) and base.attr == "debug":
+                return f"debug.{f.attr}"
+            if isinstance(base, ast.Name) and base.id == "debug":
+                return f"debug.{f.attr}"
+            return None
+        return f.attr if f.attr in BANNED_CALLS else None
+    return None
+
+
+@register
+class Obs001(Rule):
+    id = "OBS001"
+    summary = ("drivers/internal/parallel emit no ad-hoc telemetry "
+               "(print/logging/io_callback) — observability goes through "
+               "the slate_tpu.obs spine (annotate/span/events)")
+
+    def run(self, project):
+        for rel in sorted(project.modules):
+            if not rel.startswith(CHECKED_PREFIXES) or rel in EXEMPT_FILES:
+                continue
+            mod = project.modules[rel]
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.Import, ast.ImportFrom)):
+                    mods = ([a.name.split(".")[0] for a in node.names]
+                            if isinstance(node, ast.Import)
+                            else [(node.module or "").split(".")[0]])
+                    hit = BANNED_MODULES.intersection(mods)
+                    if hit:
+                        yield Finding(
+                            self.id, rel, node.lineno,
+                            f"imports `{sorted(hit)[0]}` — route telemetry "
+                            f"through slate_tpu.obs (annotate/span), not "
+                            f"ad-hoc logging")
+                    if (isinstance(node, ast.ImportFrom)
+                            and any(a.name in ("io_callback",
+                                               "pure_callback")
+                                    for a in node.names)):
+                        yield Finding(
+                            self.id, rel, node.lineno,
+                            "imports io_callback/pure_callback — recording "
+                            "must stay OUTSIDE traced code (obs events are "
+                            "host-side; a callback changes the jaxpr)")
+                elif isinstance(node, ast.Call):
+                    name = _call_name(node)
+                    if name in BANNED_CALLS or (
+                            name in ("debug.print", "debug.callback")):
+                        what = ("`print`" if name == "print"
+                                else f"`{name}`")
+                        yield Finding(
+                            self.id, rel, node.lineno,
+                            f"calls {what} — drivers/internal/parallel emit "
+                            f"telemetry only through the obs spine "
+                            f"(util.trace.annotate / span / obs.events)")
