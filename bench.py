@@ -397,6 +397,59 @@ def bench_geqrf_panel(m, n, iters):
     _emit(f"geqrf_panel_m{m}_n{n}_gflops_per_chip", gflops)
 
 
+def bench_serve_mixed(problems, nrhs, reps, sizes):
+    """Serving throughput (PR 10): a fixed seeded mixed workload — three
+    ops round-robin over ``sizes`` — through serve.Server.  The first
+    pass compiles every bucket executable (the "compile" phase the
+    watchdog may preempt); the timed passes are pure cache hits, so the
+    problems/s number is steady-state serving throughput.  Padding
+    waste is the workload-weighted mean of the per-batch obs events.
+    Emits its own lines: _emit hardcodes the GFLOP/s unit and these
+    metrics are problems/s and %."""
+    from slate_tpu import obs, serve
+
+    rng = np.random.default_rng(10)
+    ops = ("solve", "chol_solve", "least_squares_solve")
+    reqs = []
+    for i in range(problems):
+        n = int(sizes[i % len(sizes)])
+        op = ops[i % len(ops)]
+        dt = np.float32
+        if op == "least_squares_solve":
+            a = rng.standard_normal((n + 8, n)).astype(dt)
+            b = rng.standard_normal((n + 8, nrhs)).astype(dt)
+        else:
+            a = rng.standard_normal((n, n)).astype(dt)
+            if op == "chol_solve":
+                a = (a @ a.T / n + np.eye(n, dtype=dt)).astype(dt)
+            else:
+                a = a + np.eye(n, dtype=dt) * 4.0
+            b = rng.standard_normal((n, nrhs)).astype(dt)
+        reqs.append((op, a, b))
+
+    srv = serve.Server(cache=serve.ExecutableCache())
+    _PROGRESS["phase"] = "compile"
+    with obs.recording() as warm_events:
+        srv.serve_batch(reqs)              # compiles every bucket
+    _PROGRESS["phase"] = "run"
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        srv.serve_batch(reqs)
+        times.append(time.perf_counter() - t0)
+    pps = problems / min(times)
+    ev = [e for e in warm_events if e.get("kind") == "serve_batch"]
+    waste = (sum(e["padding_waste"] * e["problems"] for e in ev)
+             / max(sum(e["problems"] for e in ev), 1))
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "serve_mixed_problems_per_s",
+                      "value": round(float(pps), 2), "unit": "problems/s",
+                      "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_mixed_padding_waste_pct",
+                      "value": round(100.0 * float(waste), 2),
+                      "unit": "%", "n": problems}), flush=True)
+
+
 QUICK_STEPS = [
     (bench_gemm, dict(n=512, nb=128, iters=4)),
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
@@ -410,6 +463,8 @@ QUICK_STEPS = [
     (bench_svd, dict(n=512, nb=128, iters=2)),
     (bench_potrf_fused, dict(n=256, nb=128, bw=8, iters=2)),
     (bench_geqrf_panel, dict(m=512, n=128, iters=2)),
+    (bench_serve_mixed, dict(problems=24, nrhs=4, reps=2,
+                             sizes=(24, 48, 96))),
 ]
 
 FULL_STEPS = [
@@ -427,6 +482,8 @@ FULL_STEPS = [
     (bench_svd, dict(n=2048, nb=256, iters=3)),
     (bench_potrf_fused, dict(n=4096, nb=256, bw=8, iters=10)),
     (bench_geqrf_panel, dict(m=8192, n=256, iters=10)),
+    (bench_serve_mixed, dict(problems=96, nrhs=16, reps=3,
+                             sizes=(48, 96, 160, 320))),
 ]
 
 

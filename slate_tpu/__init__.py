@@ -65,4 +65,4 @@ from .drivers.mixed import (  # noqa: F401
     MixedResult, gesv_mixed, gesv_mixed_gmres, posv_mixed, posv_mixed_gmres,
 )
 from .util.generator import generate_hermitian, generate_matrix  # noqa: F401
-from . import api, compat, obs  # noqa: F401
+from . import api, compat, obs, serve  # noqa: F401

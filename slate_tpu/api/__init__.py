@@ -51,6 +51,7 @@ __all__ = [
     "qr_factor", "qr_multiply_by_q", "lq_factor", "lq_multiply_by_q",
     "eig", "eig_vals", "svd", "svd_vals",
     "norm", "add", "copy", "scale",
+    "batch_solve", "batch_chol_solve", "batch_least_squares_solve",
 ]
 
 
@@ -275,6 +276,40 @@ def svd(A, opts=None):
 def svd_vals(A, opts=None):
     """Singular values only (ref: svd_vals)."""
     return _svd.svd_vals(A, opts)
+
+
+# ------------------------------------------------------------------ batched
+#
+# Leading-axis entry points over the serve-layer vmap-clean cores: one
+# stack of same-shaped dense problems in, per-problem solutions plus a
+# leading-axis HealthInfo and escalation flags out.  Mixed SIZES go
+# through serve.Server (docs/SERVING.md), which buckets and packs before
+# landing on these same cores.
+
+
+def batch_solve(a, b, opts=None):
+    """Solve A_i X_i = B_i over the leading axis: ``a`` is (batch, n, n),
+    ``b`` (batch, n, k).  Returns ``(x, HealthInfo, escalated)`` with
+    per-problem health and in-graph per-problem escalation (NoPiv fast
+    rung -> partial-pivot LU; serve/batched.py)."""
+    from ..serve import batched as _batched
+    return _batched.make_batched("solve", opts)(a, b)
+
+
+def batch_chol_solve(a, b, opts=None):
+    """Solve the HPD systems A_i X_i = B_i over the leading axis; ``a``
+    holds full (symmetric) dense matrices.  Cholesky fast rung with
+    per-problem LU escalation for indefinite members."""
+    from ..serve import batched as _batched
+    return _batched.make_batched("chol_solve", opts)(a, b)
+
+
+def batch_least_squares_solve(a, b, opts=None):
+    """min ||A_i X_i - B_i|| over the leading axis, m >= n: CholQR
+    semi-normal equations with per-problem Householder-QR escalation.
+    Returns x of shape (batch, n, k)."""
+    from ..serve import batched as _batched
+    return _batched.make_batched("least_squares_solve", opts)(a, b)
 
 
 # ------------------------------------------------------------------ aux

@@ -395,4 +395,7 @@ def gels(A: Matrix, B, opts: Options | None = None) -> Matrix:
     Yp = Matrix.zeros(n, yd.shape[1], A.nb, B.nb, A.grid, yd.dtype)
     Yp = Yp.with_dense(ypad)
     # x = Qlq^H y = Qr y  (Qlq = Qr^H)
-    return unmqr(Side.Left, "n", F.F, Yp, opts)
+    X = unmqr(Side.Left, "n", F.F, Yp, opts)
+    # same boundary contract as the m >= n routes: Info returns (X, h)
+    return _health.finalize("gels", X,
+                            _health.from_result(X.storage.data), opts)

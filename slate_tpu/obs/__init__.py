@@ -18,9 +18,9 @@ returned HealthInfo and host clocks only.
 """
 
 from .events import (SCHEMA, boundary_enter, boundary_exit, clear,
-                     configure, disable, enable, enabled, note_health,
-                     note_path, note_plan, note_resolved, recent,
-                     recording)
+                     configure, disable, enable, enabled, emit_serve_batch,
+                     note_health, note_path, note_plan, note_resolved,
+                     recent, recording)
 from .metrics import render, summarize
 from .sentinel import SlateRetraceWarning
 from .sentinel import reset as reset_sentinel
@@ -30,7 +30,7 @@ from .tracer import SpanRecorder, record_spans
 __all__ = [
     "SCHEMA", "SlateRetraceWarning", "SpanRecorder", "boundary_enter",
     "boundary_exit", "clear", "configure", "disable", "enable", "enabled",
-    "note_health", "note_path", "note_plan", "note_resolved", "recent",
-    "record_spans", "recording", "render", "reset_sentinel",
-    "sentinel_stats", "summarize",
+    "emit_serve_batch", "note_health", "note_path", "note_plan",
+    "note_resolved", "recent", "record_spans", "recording", "render",
+    "reset_sentinel", "sentinel_stats", "summarize",
 ]

@@ -3,10 +3,13 @@
     python -m slate_tpu.obs events.jsonl BENCH_r07.json
     python -m slate_tpu.obs --json events.jsonl > summary.json
 
-Accepts any mix of obs event JSONL (slate-obs-v1), span JSONL, and
-bench output (slate-bench-v1 — and pre-schema BENCH_r*.json lines),
-and prints per-op latency percentiles, escalation/ABFT/certificate
-rates, plan-usage and bench tables (see docs/OBSERVABILITY.md).
+Accepts any mix of obs event JSONL (slate-obs-v1), span JSONL,
+serve_batch records (serve/server.py), and bench output
+(slate-bench-v1 — and pre-schema BENCH_r*.json lines), and prints
+per-op latency percentiles, escalation/ABFT/certificate rates,
+plan-usage, serving (bucket occupancy, padding waste, escalations per
+1k problems, retrace/compile counts) and bench tables (see
+docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations

@@ -251,6 +251,19 @@ def _build(frame: _Frame, error) -> dict:
     }
 
 
+def emit_serve_batch(payload: dict) -> None:
+    """One ``slate-obs-v1`` record per executed serving batch (kind
+    ``serve_batch``; slate_tpu.serve.server is the only caller).  The
+    payload carries bucket occupancy, padding-waste, escalation and
+    executable-cache stats — docs/SERVING.md documents the fields.  Like
+    driver boundaries this is host-side only and a no-op while recording
+    is off."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": "serve_batch", "ts": time.time(),
+           **payload})
+
+
 def _emit(event: dict) -> None:
     with _LOCK:
         _RING.append(event)

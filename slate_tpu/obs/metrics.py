@@ -2,14 +2,16 @@
 
 Consumes the two line formats the repo emits —
 
-- ``slate-obs-v1`` driver events (obs/events.py) and spans,
+- ``slate-obs-v1`` driver events (obs/events.py), spans, and
+  ``serve_batch`` records (serve/server.py),
 - ``slate-bench-v1`` bench lines (bench.py; pre-schema BENCH_r*.json
   lines are accepted too: anything with a ``metric`` key),
 
 and aggregates them into per-op latency percentiles (p50/p99 of
 ``dur_ms``), escalation / ABFT / certificate-failure rates, plan-usage
-tables and a bench-round summary.  Pure stdlib; the CLI front-end is
-obs/__main__.py.
+tables, a serving table (bucket occupancy p50/p99, padding waste,
+escalations per 1k problems, retrace/compile counts) and a bench-round
+summary.  Pure stdlib; the CLI front-end is obs/__main__.py.
 """
 
 from __future__ import annotations
@@ -50,19 +52,21 @@ def load_lines(paths) -> list[dict]:
 
 
 def split_records(records):
-    """(events, spans, bench, unknown) from a mixed record list."""
-    events, spans, bench, unknown = [], [], [], []
+    """(events, spans, serve, bench, unknown) from a mixed record list."""
+    events, spans, serve, bench, unknown = [], [], [], [], []
     for r in records:
         schema, kind = r.get("schema"), r.get("kind")
         if schema == EVENT_SCHEMA and kind == "event":
             events.append(r)
         elif schema == EVENT_SCHEMA and kind == "span":
             spans.append(r)
+        elif schema == EVENT_SCHEMA and kind == "serve_batch":
+            serve.append(r)
         elif schema == BENCH_SCHEMA or "metric" in r:
             bench.append(r)
         else:
             unknown.append(r)
-    return events, spans, bench, unknown
+    return events, spans, serve, bench, unknown
 
 
 def percentile(values, q: float) -> float | None:
@@ -156,16 +160,47 @@ def summarize_bench(bench) -> dict:
     return {"metrics": metrics, "skipped": skipped, "errors": errors}
 
 
+def summarize_serve(serve) -> dict:
+    """Serving table: per (op, dtype) batch counts, bucket occupancy
+    percentiles, padding waste, escalations per 1k problems, and the
+    retrace/compile accounting that proves a warmed server stays warm."""
+    table: dict[str, dict] = {}
+    for e in serve:
+        key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
+        s = table.setdefault(key, {
+            "batches": 0, "problems": 0, "escalated": 0, "compiles": 0,
+            "retraces": 0, "_occ": [], "_waste": []})
+        s["batches"] += 1
+        s["problems"] += int(e.get("problems") or 0)
+        s["escalated"] += int(e.get("escalated") or 0)
+        s["compiles"] += 1 if e.get("compiled") else 0
+        s["retraces"] += int(e.get("retraces") or 0)
+        if isinstance(e.get("occupancy"), (int, float)):
+            s["_occ"].append(float(e["occupancy"]))
+        if isinstance(e.get("padding_waste"), (int, float)):
+            s["_waste"].append(float(e["padding_waste"]))
+    for s in table.values():
+        occ, waste = s.pop("_occ"), s.pop("_waste")
+        s["occupancy_p50"] = percentile(occ, 50)
+        s["occupancy_p99"] = percentile(occ, 99)
+        s["padding_waste_p50"] = percentile(waste, 50)
+        probs = max(s["problems"], 1)
+        s["esc_per_1k"] = round(1000.0 * s["escalated"] / probs, 2)
+    return dict(sorted(table.items()))
+
+
 def summarize(paths) -> dict:
     """Everything the CLI prints, as one JSON-able dict."""
     records = load_lines(paths)
-    events, spans, bench, unknown = split_records(records)
+    events, spans, serve, bench, unknown = split_records(records)
     return {
         "files": [str(p) for p in paths],
         "counts": {"events": len(events), "spans": len(spans),
-                   "bench": len(bench), "unknown": len(unknown)},
+                   "serve": len(serve), "bench": len(bench),
+                   "unknown": len(unknown)},
         "ops": summarize_events(events),
         "plans": summarize_plans(events),
+        "serve": summarize_serve(serve),
         "bench": summarize_bench(bench),
     }
 
@@ -196,6 +231,7 @@ def render(summary: dict) -> str:
     parts = []
     c = summary["counts"]
     parts.append(f"records: {c['events']} events, {c['spans']} spans, "
+                 f"{c.get('serve', 0)} serve batches, "
                  f"{c['bench']} bench lines"
                  + (f", {c['unknown']} unknown" if c["unknown"] else ""))
     if summary["ops"]:
@@ -210,6 +246,14 @@ def render(summary: dict) -> str:
     if summary["plans"]:
         rows = [[k, v] for k, v in summary["plans"].items()]
         parts.append("\nplan usage\n" + _table(["plan", "calls"], rows))
+    if summary.get("serve"):
+        rows = [[key, s["batches"], s["problems"], s["occupancy_p50"],
+                 s["occupancy_p99"], s["padding_waste_p50"],
+                 s["esc_per_1k"], s["retraces"], s["compiles"]]
+                for key, s in summary["serve"].items()]
+        parts.append("\nserving\n" + _table(
+            ["op/dtype", "batches", "problems", "occ_p50", "occ_p99",
+             "waste_p50", "esc/1k", "retraces", "compiles"], rows))
     bench = summary["bench"]
     if bench["metrics"]:
         rows = [[m, d.get("value"), d.get("unit"), d.get("mfu"),

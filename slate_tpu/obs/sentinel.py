@@ -21,6 +21,7 @@ Counters are process-global; :func:`reset` clears them (tests).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import warnings
@@ -33,6 +34,26 @@ class SlateRetraceWarning(UserWarning):
 _LOCK = threading.Lock()
 _TRACES: dict[str, dict[str, int]] = {}
 _WARNED: set[tuple[str, str]] = set()
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def suppressed():
+    """Ignore driver-boundary trace records for the scope.
+
+    One deliberate staging of a program that calls N same-shaped
+    drivers enters N depth-0 boundaries in a single trace, which the
+    per-boundary counters cannot tell apart from N user retraces.  The
+    serve executable cache (the one sanctioned bulk-compile site) wraps
+    its AOT compile in this and records ONE serve-level trace for it,
+    so the sentinel keeps observing serving compiles at the granularity
+    that matters — per executable — without false retrace warnings."""
+    prev = getattr(_TLS, "suppress", False)
+    _TLS.suppress = True
+    try:
+        yield
+    finally:
+        _TLS.suppress = prev
 
 
 def _limit(env: str, default: int) -> int:
@@ -44,6 +65,8 @@ def _limit(env: str, default: int) -> int:
 
 def record_trace(op: str, signature: str) -> None:
     """Count one traced boundary execution (called by obs.events)."""
+    if getattr(_TLS, "suppress", False):
+        return
     retrace_limit = _limit("SLATE_OBS_RETRACE_LIMIT", 3)
     sig_limit = _limit("SLATE_OBS_SIGNATURE_LIMIT", 32)
     with _LOCK:

@@ -403,19 +403,27 @@ def gels_with_recovery(A, B, opts: Options | None = None):
     if speculate:
         first_name = "cholqr2"
         first = _qr._gels_cholqr_attempt(A, B, opts, refine=1, certify=True)
+        exc = _qr._gram_exc("gels")
     elif method is MethodGels.CholQR:
         first_name = "cholqr"
         first = _qr._gels_cholqr_attempt(A, B, opts)
+        exc = _qr._gram_exc("gels")
     else:
-        _obs.note_path("qr", (), 0, False)
-        return _qr.gels_qr(A, B, opts)
+        # Householder QR directly — no speculation rung, but ErrorPolicy
+        # still resolves at THIS boundary: an Info caller (or a vmapped
+        # one) gets (X, h) here exactly as on the CholQR routes, not a
+        # bare X.  bounded_retry with no fallbacks is just the growth
+        # demotion, which QR should also be subject to.
+        first_name = "qr"
+        first = _qr._gels_qr_attempt(A, B, opts)
+        exc = _singular_exc("gels")
     fallbacks = []
-    if get_option(opts, Option.UseFallbackSolver):
+    if first_name != "qr" and get_option(opts, Option.UseFallbackSolver):
         fallbacks = [lambda: _qr._gels_qr_attempt(A, B, opts)]
     X, h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
                                max_retries=1)
     _obs.note_path(first_name, ["qr"] if fallbacks else [], used, speculate)
-    return _h.finalize("gels", X, h, opts, _qr._gram_exc("gels"))
+    return _h.finalize("gels", X, h, opts, exc)
 
 
 # ------------------------------------------------------------------ shared

@@ -1,0 +1,34 @@
+"""slate_tpu.serve — shape-bucketed ragged-batch solver serving.
+
+The production-serving subsystem (docs/SERVING.md): streams of
+mixed-size ``solve`` / ``chol_solve`` / ``least_squares_solve``
+requests execute as shape-bucketed batches over the vmap-clean driver
+cores, with
+
+- a bucket ladder (geometric default, tunable via the plan cache)
+  and exact identity-augmentation packing (:mod:`bucket`),
+- per-problem in-graph escalation and leading-axis ``HealthInfo``
+  (:mod:`batched`),
+- a persistent compiled-executable cache with donated steady-state
+  buffers (:mod:`cache` — the only module allowed to compile,
+  slate-lint SEAM012),
+- a ``Server`` front end emitting one obs record per batch
+  (:mod:`server`).
+"""
+
+from .batched import (CORES, chol_solve_core, least_squares_core,
+                      make_batched, solve_core)
+from .bucket import (BucketLadder, default_ladder, geometric_ladder,
+                     least_squares_buckets, next_pow2, pad_rows, pad_square,
+                     pad_tall, solve_buckets)
+from .cache import ExecutableCache, default_cache, options_fingerprint
+from .server import SERVE_OPS, Request, Result, Server
+
+__all__ = [
+    "BucketLadder", "CORES", "ExecutableCache", "Request", "Result",
+    "SERVE_OPS", "Server", "chol_solve_core", "default_cache",
+    "default_ladder", "geometric_ladder", "least_squares_buckets",
+    "least_squares_core", "make_batched", "next_pow2",
+    "options_fingerprint", "pad_rows", "pad_square", "pad_tall",
+    "solve_buckets", "solve_core",
+]

@@ -1,0 +1,172 @@
+"""vmap-clean single-problem solve cores with in-graph escalation.
+
+The eager recovery ladders (robust/recovery.py) branch on HOST health
+values, which a vmapped problem cannot do — every problem in a batch
+shares one trace.  These cores are the serving-layer counterpart: the
+fast attempt runs first, ``health.acceptable`` gates a ``lax.cond``
+into the safe attempt, and under ``vmap`` that cond lowers to a
+per-problem select — both rungs execute batched, each problem keeps
+whichever its own health chose.  That is the deliberate trade: a
+factor-of-two worst case on the escalating bucket instead of a host
+round-trip that would serialize the whole batch (docs/SERVING.md).
+
+Ladders (mirroring the eager ones, truncated to two rungs so the cond
+stays one level):
+
+- ``solve``                NoPiv LU (speculative, growth-gated)  -> PartialPiv LU
+- ``chol_solve``           Cholesky                              -> PartialPiv LU
+- ``least_squares_solve``  CholQR semi-normal equations          -> Householder QR
+
+Every core returns ``(x_dense, HealthInfo, escalated)``; vmapped, the
+HealthInfo comes back as a leading-axis pytree (one scalar per problem
+per field — including the per-problem ABFT counters when
+``Option.Abft`` is on) and ``escalated`` as a per-problem bool.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..core.matrix import HermitianMatrix, Matrix
+from ..core.storage import TileStorage
+from ..options import ErrorPolicy, Option, Options
+from ..robust import health as _h
+from ..types import Uplo
+
+_TILE = 128
+
+
+def _tile(n: int) -> int:
+    """Static tile edge for bucket-shaped operands: one tile up to
+    _TILE, then the largest divisor-free cap the drivers pad anyway."""
+    return min(int(n), _TILE)
+
+
+def _info(opts: Options | None) -> dict:
+    o = dict(opts or {})
+    o[Option.ErrorPolicy] = ErrorPolicy.Info
+    return o
+
+
+def _demote(h, dtype):
+    """The bounded_retry growth gate, in-graph: catastrophic pivot
+    growth reads as not-converged so it both escalates and stays
+    visible in the returned health."""
+    return h._replace(
+        converged=h.converged & (h.growth <= _h.growth_limit(dtype)))
+
+
+def _mat(dense, t: int) -> Matrix:
+    return Matrix(TileStorage.from_dense(dense, t, t))
+
+
+def _cond_escalate(h1, x1, safe, operands, dtype):
+    """Shared escalation seam: keep the fast attempt where its health is
+    acceptable, run ``safe`` where not.  jit: one branch executes.
+    vmap: both branches run batched, selected per problem."""
+    escalated = ~_h.acceptable(h1, dtype)
+    x, h = lax.cond(escalated, safe, lambda ops: (x1, h1), operands)
+    return x, h, escalated
+
+
+# ------------------------------------------------------------------- cores
+
+
+def solve_core(a: jax.Array, b: jax.Array, opts: Options | None = None):
+    """General solve A x = b on bucket-shaped dense operands.
+
+    Fast rung: NoPiv LU — no pivot search, the serving speculation —
+    plus two sweeps of iterative refinement in the original system
+    (the ``_rbt_attempt`` recipe), demoted on pivot growth beyond
+    ``health.growth_limit`` exactly like the eager speculative path.
+    Safe rung: partial-pivot LU."""
+    from ..drivers import lu as _lu
+    t = _tile(a.shape[0])
+    o = _info(opts)
+
+    def attempt(factor, ops, ir_steps):
+        ad, bd = ops
+        F, fh = factor(_mat(ad, t), o)
+        xd = _lu.getrs(F, _mat(bd, t), o).to_dense()
+        for _ in range(ir_steps):          # r = b - A x, dx through F
+            rd = bd - ad @ xd
+            xd = xd + _lu.getrs(F, _mat(rd, t), o).to_dense()
+        h = _h.merge(fh, _h.from_result(xd))
+        return xd, _demote(h, ad.dtype)
+
+    x1, h1 = attempt(_lu.getrf_nopiv, (a, b), 2)
+    return _cond_escalate(h1, x1,
+                          lambda ops: attempt(_lu.getrf, ops, 0),
+                          (a, b), a.dtype)
+
+
+def chol_solve_core(a: jax.Array, b: jax.Array,
+                    opts: Options | None = None):
+    """HPD solve on bucket-shaped dense operands (full symmetric ``a``).
+
+    Fast rung: Cholesky — an indefinite problem NaN-fills its factor,
+    reads ``nonfinite`` and escalates.  Safe rung: partial-pivot LU,
+    which solves any nonsingular Hermitian system."""
+    from ..drivers import cholesky as _chol
+    from ..drivers import lu as _lu
+    t = _tile(a.shape[0])
+    o = _info(opts)
+
+    def chol(ops):
+        ad, bd = ops
+        H = HermitianMatrix._from_view(_mat(ad, t), Uplo.Lower)
+        L, fh = _chol.potrf(H, o)
+        X = _chol.potrs(L, _mat(bd, t), o)
+        h = _h.merge(fh, _h.from_result(X.storage.data))
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    def lu(ops):
+        ad, bd = ops
+        F, fh = _lu.getrf(_mat(ad, t), o)
+        X = _lu.getrs(F, _mat(bd, t), o)
+        h = _h.merge(fh, _h.from_result(X.storage.data))
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    x1, h1 = chol((a, b))
+    return _cond_escalate(h1, x1, lu, (a, b), a.dtype)
+
+
+def least_squares_core(a: jax.Array, b: jax.Array,
+                       opts: Options | None = None):
+    """Least squares min ||A x - b|| on bucket-shaped (mb, nb) operands.
+
+    Fast rung: CholQR semi-normal equations — rank deficiency or squared
+    conditioning fails the Gram Cholesky and escalates.  Safe rung:
+    Householder QR.  Returns x of shape (nb, kb)."""
+    from ..drivers import qr as _qr
+    t = _tile(a.shape[1])
+    o = _info(opts)
+
+    def cholqr(ops):
+        ad, bd = ops
+        X, h = _qr._gels_cholqr_attempt(_mat(ad, t), _mat(bd, t), o)
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    def house(ops):
+        ad, bd = ops
+        X, h = _qr._gels_qr_attempt(_mat(ad, t), _mat(bd, t), o)
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    x1, h1 = cholqr((a, b))
+    return _cond_escalate(h1, x1, house, (a, b), a.dtype)
+
+
+CORES = {
+    "solve": solve_core,
+    "chol_solve": chol_solve_core,
+    "least_squares_solve": least_squares_core,
+}
+
+
+def make_batched(op: str, opts: Options | None = None):
+    """The leading-axis-batched core for one op: vmap over problems.
+    ``opts`` is closed over as static configuration (it participates in
+    the executable-cache fingerprint, never in the traced data)."""
+    core = CORES[op]
+    return jax.vmap(lambda a, b: core(a, b, opts))

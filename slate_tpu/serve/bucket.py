@@ -1,0 +1,159 @@
+"""Shape bucketing for the serving layer: ladder + ragged packing.
+
+Mixed-size requests cannot share a compiled executable unless their
+shapes agree, so every request is rounded UP to a bucket shape drawn
+from a ladder (PAPERS.md "Ragged Paged Attention": pack ragged work
+into fixed tile grids).  The default ladder is geometric — each rung
+double the last, starting at the tile edge — because a geometric
+ladder bounds padding waste at a constant factor while keeping the
+number of distinct executables logarithmic in the size range.  A chip
+that has been profiled can override it: ``tune.serve_buckets`` reads
+``serve_bucket`` entries from the plan cache (the SEAM011-sanctioned
+accessor; see docs/SERVING.md and docs/TUNING.md).
+
+Packing is exact, not approximate: a problem of size n placed in an
+n_b-bucket is augmented with the identity — ``blockdiag(A, I)`` — the
+same trick ``internal/trsm.py::_pad_tri`` uses for ragged triangular
+tiles.  The augmented system decouples: the first n components solve
+the original problem bit-for-bit in exact arithmetic, the padding
+components solve ``I x = 0``.  For least squares the identity block is
+placed in fresh rows, keeping the padded operand full-rank and its
+Gram matrix HPD, so both the CholQR and Householder routes accept it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+DEFAULT_BASE = 32
+DEFAULT_MAX = 8192
+
+
+class BucketLadder(NamedTuple):
+    """Ascending rung sizes; ``bucket_for`` rounds a size up to a rung.
+
+    ``source`` records where the rungs came from ('geometric' or
+    'tuned') for the serve-batch obs events."""
+
+    rungs: tuple
+    source: str = "geometric"
+
+    def bucket_for(self, n: int) -> int:
+        n = int(n)
+        if n <= 0:
+            raise ValueError(f"bucket_for: need a positive size, got {n}")
+        for r in self.rungs:
+            if n <= r:
+                return int(r)
+        # beyond the top rung: keep doubling so oversize requests still
+        # bucket (and therefore still cache) instead of erroring
+        top = int(self.rungs[-1])
+        while top < n:
+            top *= 2
+        return top
+
+
+def geometric_ladder(base: int = DEFAULT_BASE,
+                     top: int = DEFAULT_MAX) -> BucketLadder:
+    rungs = []
+    r = int(base)
+    while r <= top:
+        rungs.append(r)
+        r *= 2
+    return BucketLadder(tuple(rungs), "geometric")
+
+
+def default_ladder(dtype: str = "float32") -> BucketLadder:
+    """The serving ladder: tuned rungs for this chip when the plan cache
+    has ``serve_bucket`` entries, else the geometric default."""
+    from ..tune import serve_buckets
+    tuned = serve_buckets(dtype)
+    if tuned:
+        return BucketLadder(tuple(int(r) for r in tuned), "tuned")
+    return geometric_ladder()
+
+
+def next_pow2(n: int) -> int:
+    """Batch-count bucket: smallest power of two >= n (>= 1)."""
+    n = max(int(n), 1)
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+# ------------------------------------------------------------------ packing
+#
+# All packers take/return plain dense arrays (host numpy or jnp) — the
+# batched cores re-tile inside the executable, so the packed buffers are
+# the steady-state donation surface (docs/SERVING.md).
+
+
+def pad_square(a, nb: int):
+    """blockdiag(A, I) in an (nb, nb) bucket — the ``_pad_tri`` idiom.
+
+    Exact for general and HPD solves alike: the augmented matrix is
+    nonsingular iff A is, and HPD iff A is."""
+    n = a.shape[0]
+    if a.shape[0] != a.shape[1]:
+        raise ValueError(f"pad_square: need square A, got {a.shape}")
+    if n > nb:
+        raise ValueError(f"pad_square: A ({n}) exceeds bucket ({nb})")
+    if n == nb:
+        return jnp.asarray(a)
+    out = jnp.eye(nb, dtype=a.dtype)
+    return out.at[:n, :n].set(a)
+
+
+def pad_rows(b, mb: int, kb: int):
+    """Zero-pad a (m, k) right-hand side into an (mb, kb) bucket."""
+    m, k = b.shape
+    if m > mb or k > kb:
+        raise ValueError(f"pad_rows: B {b.shape} exceeds bucket "
+                         f"({mb}, {kb})")
+    out = jnp.zeros((mb, kb), dtype=b.dtype)
+    return out.at[:m, :k].set(b)
+
+
+def pad_tall(a, mb: int, nb: int):
+    """Identity-augment a tall (m, n) operand into an (mb, nb) bucket.
+
+    The nb - n extra columns get an identity block in FRESH rows
+    (m : m + nb - n), so columns stay linearly independent and the
+    padded least-squares problem decouples: x_pad = [x; 0] exactly.
+    Requires mb >= m + (nb - n) — ``least_squares_buckets`` picks mb
+    after nb to guarantee it."""
+    m, n = a.shape
+    if m < n:
+        raise ValueError(f"pad_tall: need m >= n, got {a.shape}")
+    extra = nb - n
+    if m + extra > mb:
+        raise ValueError(f"pad_tall: bucket ({mb}, {nb}) cannot hold "
+                         f"{a.shape} plus its {extra} identity rows")
+    out = jnp.zeros((mb, nb), dtype=a.dtype)
+    out = out.at[:m, :n].set(a)
+    if extra:
+        out = out.at[m:m + extra, n:].set(jnp.eye(extra, dtype=a.dtype))
+    return out
+
+
+def solve_buckets(ladder: BucketLadder, n: int, k: int):
+    """Bucket dims (nb, kb) for a square solve of (n, n) x (n, k)."""
+    return ladder.bucket_for(n), next_pow2(k)
+
+
+def least_squares_buckets(ladder: BucketLadder, m: int, n: int, k: int):
+    """Bucket dims (mb, nb, kb) for least squares: nb first, then mb
+    large enough for the identity-augmentation rows."""
+    nb = ladder.bucket_for(n)
+    mb = ladder.bucket_for(m + (nb - n))
+    return mb, nb, next_pow2(k)
+
+
+def padded_fraction(real_elems: int, bucket_elems: int) -> float:
+    """Padding waste of one batch: 1 - real/bucket element ratio."""
+    if bucket_elems <= 0:
+        return 0.0
+    return 1.0 - real_elems / bucket_elems

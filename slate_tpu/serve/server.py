@@ -1,0 +1,207 @@
+"""The serving front end: submit mixed-size solves, drain bucketed batches.
+
+Usage::
+
+    from slate_tpu import serve
+
+    srv = serve.Server()
+    t0 = srv.submit("solve", a0, b0)              # (n0, n0), (n0, k0)
+    t1 = srv.submit("chol_solve", a1, b1)
+    t2 = srv.submit("least_squares_solve", a2, b2)
+    results = srv.drain()                         # [Result] in submit order
+
+Each ``drain`` groups pending requests by ``(op, dtype, bucket)``,
+identity-pads every problem to its bucket (bucket.py), rounds the
+batch count up to a power of two with identity filler slots, runs the
+bucket's cached executable (cache.py — compiled once, B donated), and
+unpacks per-problem results, ``HealthInfo`` and escalation flags.
+
+One ``slate-obs-v1`` record of kind ``serve_batch`` is emitted per
+executed batch (obs.events.emit_serve_batch) carrying bucket occupancy,
+padding waste, escalations, executable-cache stats and the retrace
+delta observed across the execution — the fields ``python -m
+slate_tpu.obs`` aggregates into the serving table.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs import events as _events
+from ..obs import sentinel as _sentinel
+from ..options import Options
+from ..robust.health import HealthInfo
+from . import bucket as _bucket
+from . import cache as _cache
+
+SERVE_OPS = ("solve", "chol_solve", "least_squares_solve")
+
+
+class Request(NamedTuple):
+    """One pending problem: ``op`` in SERVE_OPS, dense ``a``/``b``."""
+    op: str
+    a: np.ndarray
+    b: np.ndarray
+
+
+class Result(NamedTuple):
+    """One served problem: solution, per-problem health, whether the
+    in-graph safety rung produced it."""
+    x: np.ndarray
+    health: HealthInfo
+    escalated: bool
+
+
+def _as_2d(x, name: str) -> np.ndarray:
+    x = np.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"serve: {name} must be 2-D, got shape {x.shape}")
+    return x
+
+
+class Server:
+    """Shape-bucketed batch server over the vmap-clean solve cores.
+
+    ``opts`` apply to every request (they are part of the executable
+    fingerprint); ``ladder`` overrides the bucket ladder (default:
+    tuned rungs when the plan cache has them, else geometric);
+    ``cache`` shares or isolates the executable store (default: the
+    process-wide cache)."""
+
+    def __init__(self, opts: Options | None = None,
+                 ladder: _bucket.BucketLadder | None = None,
+                 cache: _cache.ExecutableCache | None = None):
+        self.opts = dict(opts or {})
+        self._ladder = ladder
+        self.cache = cache if cache is not None else _cache.default_cache()
+        self._pending: list[Request] = []
+
+    # ------------------------------------------------------------ intake
+
+    def ladder(self, dtype) -> _bucket.BucketLadder:
+        if self._ladder is not None:
+            return self._ladder
+        return _bucket.default_ladder(str(jnp.dtype(dtype)))
+
+    def submit(self, op: str, a, b) -> int:
+        """Queue one problem; returns its ticket (index into drain())."""
+        if op not in SERVE_OPS:
+            raise ValueError(f"serve: unknown op {op!r} "
+                             f"(known: {SERVE_OPS})")
+        a = _as_2d(a, "a")
+        b = _as_2d(b, "b")
+        if a.dtype != b.dtype:
+            raise ValueError(f"serve: a/b dtypes differ "
+                             f"({a.dtype} vs {b.dtype})")
+        if op == "least_squares_solve":
+            if a.shape[0] < a.shape[1]:
+                raise ValueError("serve: least_squares_solve needs "
+                                 f"m >= n, got {a.shape}")
+        elif a.shape[0] != a.shape[1]:
+            raise ValueError(f"serve: {op} needs square A, got {a.shape}")
+        if b.shape[0] != a.shape[0]:
+            raise ValueError(f"serve: A {a.shape} / B {b.shape} row "
+                             "mismatch")
+        self._pending.append(Request(op, a, b))
+        return len(self._pending) - 1
+
+    def serve_batch(self, requests) -> list:
+        """Synchronous convenience: submit every (op, a, b) and drain."""
+        for op, a, b in requests:
+            self.submit(op, a, b)
+        return self.drain()
+
+    # ------------------------------------------------------------- drain
+
+    def _bucket_of(self, req: Request):
+        lad = self.ladder(req.a.dtype)
+        if req.op == "least_squares_solve":
+            return _bucket.least_squares_buckets(
+                lad, req.a.shape[0], req.a.shape[1], req.b.shape[1])
+        return _bucket.solve_buckets(lad, req.a.shape[0], req.b.shape[1])
+
+    def drain(self) -> list:
+        """Execute every pending request; results in submit order."""
+        pending, self._pending = self._pending, []
+        if not pending:
+            return []
+        groups: dict = {}
+        for ticket, req in enumerate(pending):
+            key = (req.op, str(req.a.dtype), self._bucket_of(req))
+            groups.setdefault(key, []).append((ticket, req))
+        results: list = [None] * len(pending)
+        for key in sorted(groups, key=repr):
+            op, dtype, shape = key
+            for ticket, res in self._run_group(op, dtype, shape,
+                                               groups[key]):
+                results[ticket] = res
+        return results
+
+    def _run_group(self, op: str, dtype: str, shape: tuple, members):
+        t0 = time.perf_counter()
+        n_real = len(members)
+        batch = _bucket.next_pow2(n_real)
+        if len(shape) == 3:
+            mb, nb, kb = shape
+        else:
+            nb, kb = shape
+            mb = nb
+        a_pad = np.zeros((batch, mb, nb), dtype)
+        b_pad = np.zeros((batch, mb, kb), dtype)
+        real_elems = 0
+        for slot, (_, req) in enumerate(members):
+            if op == "least_squares_solve":
+                a_pad[slot] = _bucket.pad_tall(jnp.asarray(req.a), mb, nb)
+            else:
+                a_pad[slot] = _bucket.pad_square(jnp.asarray(req.a), nb)
+            b_pad[slot] = _bucket.pad_rows(jnp.asarray(req.b), mb, kb)
+            m_i, n_i = req.a.shape
+            real_elems += m_i * n_i + m_i * req.b.shape[1]
+        for slot in range(n_real, batch):          # identity filler slots
+            a_pad[slot, :nb, :nb] = np.eye(nb, dtype=dtype)
+
+        traces0 = _trace_total()
+        exe, hit = self.cache.get_or_compile(op, shape, dtype, batch,
+                                             self.opts)
+        # b is DONATED to the executable (cache.py's contract): hand it
+        # a fresh device array and never touch that buffer again
+        x, h, esc = exe(jnp.asarray(a_pad), jnp.asarray(b_pad))
+        x = np.asarray(x)
+        esc = np.asarray(esc)
+        h_np = HealthInfo(*(np.asarray(leaf) for leaf in h))
+        retraces = _trace_total() - traces0
+
+        out = []
+        for slot, (ticket, req) in enumerate(members):
+            n_i, k_i = req.a.shape[1], req.b.shape[1]
+            out.append((ticket, Result(
+                x[slot, :n_i, :k_i],
+                HealthInfo(*(leaf[slot] for leaf in h_np)),
+                bool(esc[slot]))))
+
+        bucket_elems = batch * (mb * nb + mb * kb)
+        _events.emit_serve_batch({
+            "op": op,
+            "dtype": dtype,
+            "bucket": list(shape),
+            "batch": batch,
+            "problems": n_real,
+            "occupancy": round(n_real / batch, 4),
+            "padding_waste": round(
+                _bucket.padded_fraction(real_elems, bucket_elems), 4),
+            "escalated": int(esc[:n_real].sum()),
+            "cache": self.cache.stats(),
+            "compiled": not hit,
+            "retraces": retraces,
+            "ladder": self.ladder(dtype).source,
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+        return out
+
+
+def _trace_total() -> int:
+    return sum(s["traces"] for s in _sentinel.stats().values())

@@ -1,12 +1,16 @@
 """Tile-plan autotuner: measure candidate (kernel, nb, bw) plans per
 (op, n, dtype, chip), persist winners to an on-disk JSON cache, and
 resolve them statically at trace time (plans.resolve_plan — the only
-entry point dispatch seams may use; see docs/TUNING.md)."""
+entry point dispatch seams may use; see docs/TUNING.md).  The serving
+layer's bucket ladder rides the same cache under ``SERVE_BUCKET_OP``,
+read back through :func:`plans.serve_buckets` (docs/SERVING.md)."""
 
-from .plans import (OPS, SCHEMA_VERSION, TilePlan, XLA_PLAN, cache_path,
-                    chip_kind, load_cache, plan_override, record_plan,
-                    reload, resolve_plan, save_cache, validate_cache)
+from .plans import (ALL_OPS, OPS, SCHEMA_VERSION, SERVE_BUCKET_OP, TilePlan,
+                    XLA_PLAN, cache_path, chip_kind, load_cache,
+                    plan_override, record_plan, reload, resolve_plan,
+                    save_cache, serve_buckets, validate_cache)
 
-__all__ = ["OPS", "SCHEMA_VERSION", "TilePlan", "XLA_PLAN", "cache_path",
-           "chip_kind", "load_cache", "plan_override", "record_plan",
-           "reload", "resolve_plan", "save_cache", "validate_cache"]
+__all__ = ["ALL_OPS", "OPS", "SCHEMA_VERSION", "SERVE_BUCKET_OP", "TilePlan",
+           "XLA_PLAN", "cache_path", "chip_kind", "load_cache",
+           "plan_override", "record_plan", "reload", "resolve_plan",
+           "save_cache", "serve_buckets", "validate_cache"]

@@ -43,6 +43,11 @@ from typing import NamedTuple
 SCHEMA_VERSION = 1
 OPS = ("potrf_tile", "potrf_panel", "getrf_panel", "lu_select",
        "geqrf_panel")
+# The serving layer's bucket ladder rides the same cache file but is NOT a
+# kernel-tuning op (no candidate sweep): each recorded entry's ``n`` is one
+# ladder rung for this chip (see serve_buckets / docs/SERVING.md).
+SERVE_BUCKET_OP = "serve_bucket"
+ALL_OPS = OPS + (SERVE_BUCKET_OP,)
 KERNELS = ("xla", "pallas")
 
 
@@ -108,9 +113,9 @@ def validate_cache(obj) -> None:
         if not isinstance(ops, dict):
             raise ValueError(f"plan cache: chip {chip!r} must map ops")
         for op, entries in ops.items():
-            if op not in OPS:
+            if op not in ALL_OPS:
                 raise ValueError(f"plan cache: unknown op {op!r} "
-                                 f"(known: {OPS})")
+                                 f"(known: {ALL_OPS})")
             if not isinstance(entries, dict):
                 raise ValueError(f"plan cache: {chip}/{op} must be an "
                                  "object")
@@ -201,8 +206,8 @@ def record_plan(op: str, n: int, dtype: str, plan: TilePlan,
                 path: str | None = None) -> str:
     """Persist one winning plan (autotuner/tests only — drivers resolve
     through resolve_plan)."""
-    if op not in OPS:
-        raise ValueError(f"unknown op {op!r} (known: {OPS})")
+    if op not in ALL_OPS:
+        raise ValueError(f"unknown op {op!r} (known: {ALL_OPS})")
     obj = load_cache(path)
     ent = {"kernel": plan.kernel, "nb": int(plan.nb), "bw": int(plan.bw)}
     if gflops is not None:
@@ -216,8 +221,8 @@ def record_plan(op: str, n: int, dtype: str, plan: TilePlan,
 @contextlib.contextmanager
 def plan_override(op: str, plan: TilePlan):
     """Force ``resolve_plan(op, ...)`` to return ``plan`` (tests)."""
-    if op not in OPS:
-        raise ValueError(f"unknown op {op!r} (known: {OPS})")
+    if op not in ALL_OPS:
+        raise ValueError(f"unknown op {op!r} (known: {ALL_OPS})")
     prev = _OVERRIDES.get(op)
     _OVERRIDES[op] = plan
     try:
@@ -288,3 +293,20 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
         source = "exact" if dist == 0.0 else "nearest"
     _obs.note_plan(op, int(n), dtype, plan.kernel, plan.nb, source, dist)
     return plan
+
+
+def serve_buckets(dtype: str = "float32") -> tuple[int, ...] | None:
+    """Tuned serving bucket ladder for this chip, or None when untuned.
+
+    The serving layer (slate_tpu.serve.bucket) calls THIS accessor — not
+    the raw cache (SEAM011) — to override its default geometric ladder.
+    Each ``serve_bucket`` entry recorded via :func:`record_plan` (op
+    ``SERVE_BUCKET_OP``, ``n`` = the bucket edge, kernel/nb/bw ignored)
+    contributes one rung; the returned tuple is sorted ascending."""
+    entries = _cached().get("chips", {}).get(chip_kind(), {}).get(
+        SERVE_BUCKET_OP)
+    if not entries:
+        return None
+    rungs = sorted({n for n, dt in map(_parse_key, entries)
+                    if dt == dtype})
+    return tuple(rungs) or None

@@ -66,6 +66,22 @@ def test_metric_emits_json(bench, capsys, name, kwargs):
         assert line["plain_gflops"] > 0
 
 
+def test_serve_mixed_emits_throughput_and_waste(bench, capsys):
+    """bench_serve_mixed emits its own two lines (problems/s and padding
+    waste %) — it bypasses _emit, whose unit is hardwired to GFLOP/s."""
+    bench.bench_serve_mixed(problems=9, nrhs=2, reps=1, sizes=(12, 24, 40))
+    lines = _lines(capsys)
+    by_metric = {ln["metric"]: ln for ln in lines}
+    assert set(by_metric) == {"serve_mixed_problems_per_s",
+                              "serve_mixed_padding_waste_pct"}
+    pps = by_metric["serve_mixed_problems_per_s"]
+    assert pps["schema"] == "slate-bench-v1" and "chip" in pps
+    assert pps["unit"] == "problems/s" and pps["value"] > 0
+    waste = by_metric["serve_mixed_padding_waste_pct"]
+    assert waste["unit"] == "%"
+    assert 0.0 <= waste["value"] <= 100.0
+
+
 def test_step_lists_cover_every_metric(bench):
     """Both step lists must include the RBT speculation metric and stay
     callable (functions exist, kwargs are their signature's names)."""
@@ -75,6 +91,7 @@ def test_step_lists_cover_every_metric(bench):
         assert "bench_gesv_rbt" in names
         assert "bench_gesv_abft" in names
         assert "bench_posv_abft" in names
+        assert "bench_serve_mixed" in names
         for fn, kwargs in steps:
             sig = inspect.signature(fn)
             assert set(kwargs) == set(sig.parameters)

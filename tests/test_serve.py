@@ -1,0 +1,339 @@
+"""Serving-layer tests (slate_tpu/serve/): bucket ladder, identity-
+augmentation packing, the Server front end, the executable cache, and
+the observability contract.
+
+The load-bearing guarantees:
+
+- packing is EXACT — a problem served from a bucket matches the
+  unpadded solve at rounding level (blockdiag(A, I) decouples);
+- bucket-boundary sizes (n exactly at a rung, one above, singleton
+  batches) pack and unpack correctly;
+- one poisoned problem escalates IN-GRAPH while its batch neighbors
+  ride the fast rung, and only its Result says so;
+- a warmed server never retraces and never compiles again: the second
+  pass over the same workload produces zero retrace-sentinel warnings,
+  zero cache misses, and serve_batch events with compiled=False —
+  asserted from the obs events, which is how production would see it;
+- the tuned serving ladder (tune.serve_buckets) overrides the
+  geometric default and is credited in the events;
+- ``python -m slate_tpu.obs`` aggregates serve_batch records into the
+  serving table.
+"""
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu import obs, serve, tune
+from slate_tpu.serve import bucket
+
+RES_TOL = 100  # residual < RES_TOL * eps * n — the certificate reading
+
+
+def _workload_rng():
+    return np.random.default_rng(1234)
+
+
+def _mk_solve(rng, n, k, dtype):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    a += np.eye(n, dtype=dtype) * 4
+    return a, rng.standard_normal((n, k)).astype(dtype)
+
+
+def _mk_chol(rng, n, k, dtype):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    spd = (a @ a.T / n + np.eye(n, dtype=dtype)).astype(dtype)
+    return spd, rng.standard_normal((n, k)).astype(dtype)
+
+
+def _mk_gels(rng, n, k, dtype):
+    a = rng.standard_normal((n + 10, n)).astype(dtype)
+    return a, rng.standard_normal((n + 10, k)).astype(dtype)
+
+
+def _residual(a, x, b):
+    a, x, b = (v.astype(np.float64) for v in (a, x, b))
+    denom = np.linalg.norm(a) * np.linalg.norm(x) + np.linalg.norm(b)
+    return np.linalg.norm(a @ x - b) / max(denom, 1e-300)
+
+
+def _check(req, res):
+    """Certificate-tolerance check of one served Result."""
+    op, a, b = req
+    eps = float(np.finfo(a.dtype).eps)
+    n = a.shape[1]
+    if op == "least_squares_solve":
+        # optimality: residual orthogonal to range(A)
+        r = (a.astype(np.float64) @ res.x.astype(np.float64)
+             - b.astype(np.float64))
+        grad = np.linalg.norm(a.T.astype(np.float64) @ r)
+        scale = np.linalg.norm(a) ** 2 * max(np.linalg.norm(res.x), 1.0)
+        assert grad / scale < RES_TOL * eps * n
+    else:
+        assert _residual(a, res.x, b) < RES_TOL * eps * n
+    assert res.x.shape == (n, b.shape[1])
+    assert bool(res.health.ok)
+
+
+def _serve_events(records):
+    return [e for e in records if e.get("kind") == "serve_batch"]
+
+
+# ------------------------------------------------------------- ladder
+
+
+def test_geometric_ladder_rounds_up():
+    lad = bucket.geometric_ladder(base=32, top=256)
+    assert lad.rungs == (32, 64, 128, 256)
+    assert lad.source == "geometric"
+    assert lad.bucket_for(1) == 32
+    assert lad.bucket_for(32) == 32        # exactly at a rung: no pad
+    assert lad.bucket_for(33) == 64        # one above: next rung
+    assert lad.bucket_for(256) == 256
+    assert lad.bucket_for(257) == 512      # beyond top: keep doubling
+    assert lad.bucket_for(3000) == 4096
+    with pytest.raises(ValueError):
+        lad.bucket_for(0)
+
+
+def test_next_pow2():
+    assert [bucket.next_pow2(v) for v in (0, 1, 2, 3, 4, 5, 9)] == \
+        [1, 1, 2, 4, 4, 8, 16]
+
+
+def test_least_squares_buckets_hold_identity_rows():
+    lad = bucket.geometric_ladder()
+    mb, nb, kb = bucket.least_squares_buckets(lad, 50, 20, 5)
+    assert nb == 32 and kb == 8
+    assert mb >= 50 + (nb - 20)            # room for the identity block
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((50, 20)))
+    padded = np.asarray(bucket.pad_tall(a, mb, nb))
+    assert np.linalg.matrix_rank(padded) == nb   # stays full column rank
+
+
+def test_pad_square_is_blockdiag_identity():
+    rng = _workload_rng()
+    a, b = _mk_solve(rng, 20, 3, np.float64)
+    ap = np.asarray(bucket.pad_square(jnp.asarray(a), 32))
+    np.testing.assert_array_equal(ap[:20, :20], a)
+    np.testing.assert_array_equal(ap[20:, 20:], np.eye(12))
+    np.testing.assert_array_equal(ap[:20, 20:], 0)
+    # the padded system solves to [x; 0] exactly (decoupled)
+    bp = np.asarray(bucket.pad_rows(jnp.asarray(b), 32, 4))
+    xp = np.linalg.solve(ap, bp)
+    np.testing.assert_allclose(xp[:20, :3], np.linalg.solve(a, b),
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(xp[20:], 0, atol=1e-300)
+
+
+# ------------------------------------------------------------- server
+
+
+@pytest.mark.parametrize("n", [32, 33, 20])
+@pytest.mark.parametrize("op,mk", [
+    ("solve", _mk_solve), ("chol_solve", _mk_chol),
+    ("least_squares_solve", _mk_gels)], ids=["solve", "chol", "gels"])
+def test_singleton_and_boundary_sizes(op, mk, n):
+    """Bucket-edge sizes (exactly at a rung, one above) and a singleton
+    batch unpack to the right shapes and certificate-level accuracy."""
+    rng = _workload_rng()
+    a, b = mk(rng, n, 3, np.float64)
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as recs:
+        (res,) = srv.serve_batch([(op, a, b)])
+    _check((op, a, b), res)
+    assert res.escalated in (False, True)
+    (ev,) = _serve_events(recs)
+    assert ev["problems"] == 1 and ev["batch"] == 1
+    assert ev["occupancy"] == 1.0
+    expected_nb = bucket.geometric_ladder().bucket_for(n)
+    assert expected_nb in ev["bucket"]
+
+
+def test_mixed_workload_parity_and_isolated_escalation():
+    """The acceptance workload: >= 64 problems, n spanning >= 3 buckets,
+    both dtypes, served in bucketed batches — every result within
+    certificate tolerance of its per-problem reference, with poisoned
+    members escalating independently of their batch neighbors."""
+    rng = _workload_rng()
+    reqs, poisoned = [], []
+    for dtype in (np.float32, np.float64):
+        for n in (20, 40, 70):             # buckets 32, 64, 128
+            for j in range(4):
+                reqs.append(("solve", *_mk_solve(rng, n, 3, dtype)))
+                reqs.append(("chol_solve", *_mk_chol(rng, n, 3, dtype)))
+                reqs.append(("least_squares_solve",
+                             *_mk_gels(rng, n, 2, dtype)))
+    # poison one solve member per dtype: row 0 = e_{n-1} kills the NoPiv
+    # fast rung (zero leading pivot) but partial pivoting handles it
+    for dtype in (np.float32, np.float64):
+        n = 40
+        a, b = _mk_solve(rng, n, 3, dtype)
+        a[0, :] = 0.0
+        a[0, n - 1] = 1.0
+        poisoned.append(len(reqs))
+        reqs.append(("solve", a, b))
+    assert len(reqs) >= 64
+
+    srv = serve.Server(cache=serve.ExecutableCache())
+    results = srv.serve_batch(reqs)
+    assert len(results) == len(reqs)
+    for i, (req, res) in enumerate(zip(reqs, results)):
+        _check(req, res)
+    for i in poisoned:
+        assert results[i].escalated, "poisoned member must escalate"
+    # escalation stayed per-problem: the healthy solves in the same
+    # (op, dtype, bucket) batch as the poisoned ones rode the fast rung
+    neighbors = [i for i, r in enumerate(reqs)
+                 if r[0] == "solve" and r[1].shape[0] == 40
+                 and i not in poisoned]
+    assert neighbors and not any(results[i].escalated for i in neighbors)
+
+
+def test_warm_server_never_retraces_or_recompiles():
+    """After warmup, a repeat of the same mixed workload is all cache
+    hits: zero retrace-sentinel warnings (filter promoted to error),
+    zero new executable-cache entries, compiled=False on every
+    serve_batch event — asserted via the obs events."""
+    rng = _workload_rng()
+    reqs = []
+    for n in (20, 40):
+        reqs.append(("solve", *_mk_solve(rng, n, 3, np.float64)))
+        reqs.append(("chol_solve", *_mk_chol(rng, n, 3, np.float64)))
+        reqs.append(("least_squares_solve",
+                     *_mk_gels(rng, n, 2, np.float64)))
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as cold:
+        srv.serve_batch(reqs)
+    cold_ev = _serve_events(cold)
+    assert cold_ev and all(e["compiled"] for e in cold_ev)
+    entries0 = srv.cache.stats()["entries"]
+    traces0 = sum(s["traces"] for s in obs.sentinel_stats().values())
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.SlateRetraceWarning)
+        with obs.recording() as warm:
+            results = srv.serve_batch(reqs)
+    warm_ev = _serve_events(warm)
+    assert len(warm_ev) == len(cold_ev)
+    assert not any(e["compiled"] for e in warm_ev)
+    assert all(e["retraces"] == 0 for e in warm_ev)
+    assert all(e["cache"]["entries"] == entries0 for e in warm_ev)
+    traces1 = sum(s["traces"] for s in obs.sentinel_stats().values())
+    assert traces1 == traces0
+    for req, res in zip(reqs, results):
+        _check(req, res)
+
+
+def test_donation_steady_state_submit_loop():
+    """The steady-state serving loop — many drains against one warmed
+    executable, B donated each call — stays retrace-free and keeps
+    producing correct results from the (re)donated buffers."""
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    warm = [("solve", *_mk_solve(rng, 24, 3, np.float64))
+            for _ in range(2)]
+    srv.serve_batch(warm)
+    traces0 = sum(s["traces"] for s in obs.sentinel_stats().values())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.SlateRetraceWarning)
+        for _ in range(5):
+            reqs = [("solve", *_mk_solve(rng, 24, 3, np.float64))
+                    for _ in range(2)]
+            for req, res in zip(reqs, srv.serve_batch(reqs)):
+                _check(req, res)
+    assert sum(s["traces"] for s in obs.sentinel_stats().values()) == traces0
+    st = srv.cache.stats()
+    assert st["entries"] == 1 and st["misses"] == 1 and st["hits"] == 5
+
+
+def test_submit_validation():
+    srv = serve.Server(cache=serve.ExecutableCache())
+    a, b = _mk_solve(_workload_rng(), 8, 2, np.float64)
+    with pytest.raises(ValueError, match="unknown op"):
+        srv.submit("qr", a, b)
+    with pytest.raises(ValueError, match="2-D"):
+        srv.submit("solve", a[0], b)
+    with pytest.raises(ValueError, match="dtypes differ"):
+        srv.submit("solve", a, b.astype(np.float32))
+    with pytest.raises(ValueError, match="square"):
+        srv.submit("solve", a[:6], b[:6])
+    with pytest.raises(ValueError, match="row"):
+        srv.submit("solve", a, b[:6])
+    with pytest.raises(ValueError, match="m >= n"):
+        srv.submit("least_squares_solve", a[:6], b[:6])
+    assert srv.drain() == []               # nothing valid was queued
+
+
+# ------------------------------------------------- tuned ladder override
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    path = tmp_path / "plans.json"
+    monkeypatch.setenv("SLATE_TUNE_CACHE", str(path))
+    tune.reload()
+    yield path
+    tune.reload()
+
+
+def test_tuned_ladder_overrides_geometric(plan_cache):
+    for rung in (48, 96, 192):
+        tune.record_plan(tune.SERVE_BUCKET_OP, rung, "float64",
+                         tune.XLA_PLAN)
+    lad = bucket.default_ladder("float64")
+    assert lad.source == "tuned"
+    assert lad.rungs == (48, 96, 192)
+    assert lad.bucket_for(50) == 96
+    # untouched dtype falls back to geometric
+    assert bucket.default_ladder("float32").source == "geometric"
+
+    rng = _workload_rng()
+    a, b = _mk_solve(rng, 40, 3, np.float64)
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as recs:
+        (res,) = srv.serve_batch([("solve", a, b)])
+    _check(("solve", a, b), res)
+    (ev,) = _serve_events(recs)
+    assert ev["ladder"] == "tuned"
+    assert ev["bucket"][0] == 48           # tuned rung, not geometric 64
+
+
+# ------------------------------------------------------- obs aggregation
+
+
+def test_metrics_serving_table(tmp_path):
+    rng = _workload_rng()
+    reqs = []
+    for n in (20, 40):
+        for _ in range(2):
+            reqs.append(("solve", *_mk_solve(rng, n, 3, np.float32)))
+            reqs.append(("chol_solve", *_mk_chol(rng, n, 3, np.float32)))
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as recs:
+        srv.serve_batch(reqs)
+        srv.serve_batch(reqs)              # a warm round too
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in recs))
+
+    summary = obs.summarize([str(path)])
+    assert summary["counts"]["serve"] == len(_serve_events(recs))
+    table = summary["serve"]
+    assert "solve/float32" in table and "chol_solve/float32" in table
+    row = table["solve/float32"]
+    assert row["problems"] == 8            # 4 per round, 2 rounds
+    assert row["batches"] == 4             # 2 buckets x 2 rounds
+    assert 0.0 < row["occupancy_p50"] <= 1.0
+    assert row["occupancy_p99"] <= 1.0
+    assert 0.0 <= row["padding_waste_p50"] < 1.0
+    assert row["esc_per_1k"] == 0.0
+    assert row["compiles"] == 2            # cold round only
+    assert row["retraces"] >= 0
+
+    from slate_tpu.obs import metrics
+    text = metrics.render(summary)
+    assert "serving" in text and "solve/float32" in text
+    assert "esc/1k" in text

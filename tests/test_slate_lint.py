@@ -219,6 +219,52 @@ def test_shard_map_lambda_closure_args_stay_static(tmp_path):
     assert [f.line for f in fs] == [10]
 
 
+def test_vmap_is_a_traced_entry(tmp_path):
+    """``jax.vmap(f)`` runs f under a batching trace: everything f
+    reaches is traced exactly as under jit, so a host branch on its
+    argument fires TRC001 (the serving layer enters drivers this way)."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def solve_one(a, b):
+            if jnp.sum(a) > 0:           # traced under the batching trace
+                return a + b
+            return a - b
+
+
+        def batched(a, b):
+            return jax.vmap(solve_one)(a, b)
+        """})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [6]
+
+
+def test_vmap_lambda_closure_args_stay_static(tmp_path):
+    """The serve/batched.py idiom — ``jax.vmap(lambda a, b: core(a, b,
+    opts))`` — traces only the lambda's params; the closure-bound opts
+    stays a static config the core may branch on."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def core(a, b, opts):
+            if opts.get("fast"):         # static: closure-bound dict
+                a = a * 2
+            if jnp.sum(b) > 0:           # traced: fed from lambda param
+                a = -a
+            return a
+
+
+        def make_batched(opts):
+            return jax.vmap(lambda a, b: core(a, b, opts))
+        """})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [8]
+
+
 def test_defaulted_params_of_loop_bodies_stay_static(tmp_path):
     """``def step(k, c, W0=W0)`` static-capture idiom: defaulted params of
     non-entry nested defs are not tainted."""
@@ -788,6 +834,37 @@ def test_seam011_silent_inside_tune_and_via_resolver(tmp_path):
         "def qr(a, opts=None):\n"
         "    plan = resolve_plan('geqrf_panel', 128)\n"
         "    return health.finalize(a)\n")
+    assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
+
+
+def test_seam012_fires_on_direct_compile_in_serve(tmp_path):
+    """serve/ modules other than cache.py compiling for themselves
+    (jax.jit / lower / compile) bypass the executable-cache accounting
+    and fire SEAM012."""
+    files = seam_skeleton()
+    files["slate_tpu/serve/server.py"] = (
+        "import jax\n\n\n"
+        "def run(fn, a):\n"
+        "    exe = jax.jit(fn).lower(a).compile()\n"
+        "    return exe(a)\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM012"}
+    assert any("jit" in f.message for f in fs)
+
+
+def test_seam012_silent_in_cache_and_via_cache(tmp_path):
+    """serve/cache.py is the one sanctioned compile site; a server that
+    gets executables from it stays clean."""
+    files = seam_skeleton()
+    files["slate_tpu/serve/cache.py"] = (
+        "import jax\n\n\n"
+        "def get_or_compile(fn, spec):\n"
+        "    return jax.jit(fn).lower(spec).compile()\n")
+    files["slate_tpu/serve/server.py"] = (
+        "from .cache import get_or_compile\n\n\n"
+        "def run(fn, a):\n"
+        "    exe = get_or_compile(fn, a)\n"
+        "    return exe(a)\n")
     assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
 
 

@@ -1,0 +1,189 @@
+"""vmap-cleanliness parity suite for the hot solve drivers (PR 10).
+
+The serving layer (slate_tpu/serve/) executes shape-bucketed BATCHES by
+vmapping the drivers, so gesv / posv / gels must be vmap-clean end to
+end: same numbers as a per-problem loop, HealthInfo batched as a
+leading-axis pytree (every leaf gains the batch dim — nothing inside a
+driver may concretize a traced health value on the way out), and
+per-problem ABFT counters.
+
+Also pins the policy-seam regression this PR fixed: gels' direct
+Householder-QR route (m < 3n, speculation off) used to return a bare X
+under ErrorPolicy.Info instead of (X, h) — unnoticeable eagerly if the
+caller ignored health, fatal under vmap where the tuple arity is part
+of the batched pytree structure.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.core.storage import TileStorage
+from slate_tpu.options import Option
+from slate_tpu.robust import faults
+
+INFO = {Option.ErrorPolicy: "info"}
+NB = 16
+HEALTH_LEAVES = 10  # HealthInfo field count (arity change = update serve/)
+
+
+def _mat(dense):
+    return st.Matrix(TileStorage.from_dense(dense, NB, NB))
+
+
+def _gesv_one(ad, bd):
+    F, X, h = st.gesv(_mat(ad), _mat(bd), INFO)
+    return X.to_dense(), h
+
+
+def _posv_one(ad, bd):
+    H = st.HermitianMatrix._from_view(_mat(ad), st.Uplo.Lower)
+    F, X, h = st.posv(H, _mat(bd), INFO)
+    return X.to_dense(), h
+
+
+def _gels_one(ad, bd):
+    X, h = st.gels(_mat(ad), _mat(bd), INFO)
+    return X.to_dense(), h
+
+
+def _problems(rng, op, dtype, batch=3, n=32, k=5):
+    a = rng.standard_normal((batch, n, n)).astype(dtype)
+    b = rng.standard_normal((batch, n, k)).astype(dtype)
+    if op == "posv":
+        a = (np.einsum("bij,bkj->bik", a, a) / n
+             + np.eye(n, dtype=dtype)[None]).astype(dtype)
+    elif op == "gesv":
+        a = a + np.eye(n, dtype=dtype)[None] * 4
+    else:  # gels: tall
+        m = n + 24
+        a = rng.standard_normal((batch, m, n)).astype(dtype)
+        b = rng.standard_normal((batch, m, k)).astype(dtype)
+    return a, b
+
+
+ONE = {"gesv": _gesv_one, "posv": _posv_one, "gels": _gels_one}
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("op", ["gesv", "posv", "gels"])
+def test_vmap_matches_per_problem_loop(rng, op, dtype):
+    """vmap(driver) agrees with [driver(p) for p] — results and health.
+    Tolerance is a small multiple of eps: batched GEMMs reassociate, so
+    bitwise equality is not on the table, but the error must stay at
+    rounding level (same algorithm, same escalation decisions)."""
+    a, b = _problems(rng, op, dtype)
+    tol = 200 * np.finfo(dtype).eps
+    one = ONE[op]
+    xv, hv = jax.vmap(one)(jnp.asarray(a), jnp.asarray(b))
+    for i in range(a.shape[0]):
+        xi, hi = one(jnp.asarray(a[i]), jnp.asarray(b[i]))
+        scale = float(np.abs(np.asarray(xi)).max())
+        np.testing.assert_allclose(np.asarray(xv[i]), np.asarray(xi),
+                                   atol=tol * scale, rtol=0)
+        # health: discrete leaves exact, float diagnostics at rounding
+        for name, lv, li in zip(hv._fields, hv, hi):
+            got, want = np.asarray(lv[i]), np.asarray(li)
+            if np.issubdtype(want.dtype, np.floating):
+                np.testing.assert_allclose(got, want, rtol=1e-3,
+                                           err_msg=name)
+            else:
+                np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+@pytest.mark.parametrize("op", ["gesv", "posv", "gels"])
+def test_health_batches_as_leading_axis_pytree(rng, op):
+    """Every HealthInfo leaf gains the batch dim; .ok stays computable."""
+    batch = 4
+    a, b = _problems(rng, op, np.float64, batch=batch)
+    _, h = jax.vmap(ONE[op])(jnp.asarray(a), jnp.asarray(b))
+    leaves = jax.tree_util.tree_leaves(h)
+    assert len(leaves) == HEALTH_LEAVES
+    for leaf in leaves:
+        assert leaf.shape[0] == batch, leaf.shape
+    assert np.asarray(h.ok).shape == (batch,)
+    assert np.asarray(h.ok).all()
+
+
+def test_vmap_abft_counters_are_per_problem(rng):
+    """Under vmap with a bitflip injected into the factor panel, every
+    problem detects and corrects ITS OWN strike: counters (not scalars
+    silently shared across the batch) come back with shape (batch,),
+    and the repaired results still match the reference solve."""
+    n, batch = 32, 3
+    a = rng.standard_normal((batch, n, n)) + np.eye(n)[None] * n
+    b = rng.standard_normal((batch, n, 8))
+    abft_opts = {Option.ErrorPolicy: "info", Option.Abft: "on"}
+
+    def run(ad, bd):
+        F, X, h = st.gesv(_mat(ad), _mat(bd), abft_opts)
+        return X.to_dense(), h
+
+    x, h = jax.vmap(run)(jnp.asarray(a), jnp.asarray(b))
+    assert np.asarray(h.abft_detected).shape == (batch,)
+    assert (np.asarray(h.abft_detected) == 0).all()
+
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=5,
+                            tile=(n // NB - 1, 0), nb=NB)
+    with faults.inject(plan):
+        x, h = jax.vmap(run)(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_array_equal(np.asarray(h.abft_detected),
+                                  np.ones(batch, dtype=np.int64))
+    np.testing.assert_array_equal(np.asarray(h.abft_corrected),
+                                  np.ones(batch, dtype=np.int64))
+    assert np.asarray(h.ok).all()
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(a, b),
+                               atol=1e-9)
+
+
+@pytest.mark.parametrize("speculate", ["off", "on"])
+def test_gels_qr_route_honors_info_policy(rng, speculate):
+    """The direct Householder-QR route of gels (m < 3n so CholQR is not
+    selected, speculation off) must return (X, h) under Info exactly as
+    the CholQR routes do — the seam regression that broke gels under
+    vmap.  With speculation on the same shape takes CholQR2 first; both
+    routes must agree on the contract."""
+    m, n, k = 40, 32, 4          # m < 3n: method resolution picks QR
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, k))
+    opts = dict(INFO)
+    opts[Option.Speculate] = speculate
+    out = st.gels(st.Matrix.from_numpy(a, NB, NB),
+                  st.Matrix.from_numpy(b, NB, NB), opts)
+    assert isinstance(out, tuple) and len(out) == 2
+    X, h = out
+    assert isinstance(h, st.HealthInfo)
+    assert bool(h.ok)
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy()[:n], ref, atol=1e-8)
+
+
+def test_gels_min_norm_route_honors_info_policy(rng):
+    """The m < n minimum-norm route resolves ErrorPolicy too (the second
+    bare-return fixed this PR)."""
+    m, n, k = 24, 40, 3
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, k))
+    out = st.gels(st.Matrix.from_numpy(a, NB, NB),
+                  st.Matrix.from_numpy(b, NB, NB), INFO)
+    assert isinstance(out, tuple) and len(out) == 2
+    X, h = out
+    assert isinstance(h, st.HealthInfo)
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(X.to_numpy()[:n], ref, atol=1e-8)
+
+
+@pytest.mark.parametrize("op", ["gesv", "posv", "gels"])
+def test_vmap_composes_with_jit(rng, op):
+    """jit(vmap(driver)) — the serving execution shape — stays exact
+    against the eager per-problem loop."""
+    a, b = _problems(rng, op, np.float64)
+    one = ONE[op]
+    xv, hv = jax.jit(jax.vmap(one))(jnp.asarray(a), jnp.asarray(b))
+    for i in range(a.shape[0]):
+        xi, _ = one(jnp.asarray(a[i]), jnp.asarray(b[i]))
+        np.testing.assert_allclose(np.asarray(xv[i]), np.asarray(xi),
+                                   rtol=1e-12, atol=1e-12)
+    assert np.asarray(hv.ok).all()

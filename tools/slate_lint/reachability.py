@@ -7,7 +7,10 @@ A function is traced when jax traces it rather than running it eagerly:
   ``jax.shard_map(f, ...)``, ``shard_map_unchecked(f, ...)`` (the compat
   shim in ``util/compat_jax.py``), ``pl.pallas_call(kernel, ...)`` or
   ``pl.pallas_call(partial(kernel, bw=bw), ...)`` (partial keywords are
-  static parameters of the kernel entry);
+  static parameters of the kernel entry), or ``jax.vmap(f)`` — a vmapped
+  function runs under a batching trace, so everything it reaches is
+  traced exactly as under jit (the serving layer's batched cores enter
+  drivers this way);
 - **transitively traced** — reachable from a traced function through the
   lexically-resolvable call graph: direct calls, bare function references
   (e.g. a body handed to ``lax.fori_loop`` / ``lax.scan``), and nested
@@ -32,7 +35,8 @@ import ast
 from .loader import Project, SourceModule
 
 #: wrappers whose first callable argument becomes a traced entry
-ENTRY_WRAPPERS = {"jit", "shard_map", "shard_map_unchecked", "pallas_call"}
+ENTRY_WRAPPERS = {"jit", "shard_map", "shard_map_unchecked", "pallas_call",
+                  "vmap"}
 #: jit-like wrappers that honour static_argnames
 JIT_LIKE = {"jit"}
 

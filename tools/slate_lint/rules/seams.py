@@ -25,10 +25,15 @@ SEAM010 point 10 — Option.Abft never read in a driver module
 SEAM011 (new, PR 7) — the raw autotuner plan cache (load_cache /
         save_cache / cache_path / record_plan) is only touched inside
         slate_tpu/tune/; everything else goes through resolve_plan
+SEAM012 (new, PR 10) — serve/ obtains executables ONLY through the
+        serve executable cache: no jax.jit / .lower() / .compile()
+        anywhere in slate_tpu/serve/ except serve/cache.py, so every
+        serving compile is accounted in ExecutableCache.stats and
+        surfaced in per-batch obs events
 ====== ===============================================================
 
-SEAM011 has no legacy twin (it postdates the migration); its ``legacy``
-string is the modern ``path:line: msg`` form.
+SEAM011 and SEAM012 have no legacy twins (they postdate the migration);
+their ``legacy`` strings are the modern ``path:line: msg`` form.
 """
 
 from __future__ import annotations
@@ -71,6 +76,11 @@ TUNE_DIR = "slate_tpu/tune"
 #: so a cache-format change (or a corrupt cache file) has ONE blast radius
 RAW_PLAN_CACHE_NAMES = {"load_cache", "save_cache", "cache_path",
                         "record_plan"}
+
+SERVE_DIR = "slate_tpu/serve"
+SERVE_CACHE_MODULE = f"{SERVE_DIR}/cache.py"
+#: compile-producing constructs banned outside the serve executable cache
+SERVE_COMPILE_NAMES = {"jit", "lower", "compile", "aot_compile"}
 
 ABFT_MODULE = "slate_tpu/robust/abft.py"
 FAULTS_MODULE = "slate_tpu/robust/faults.py"
@@ -203,6 +213,7 @@ def seam_scan(project) -> list[tuple[str, Finding]]:
     out.extend(_scan_abft(project))
     out.extend(_scan_driver_contract(project))
     out.extend(_scan_tune(project))
+    out.extend(_scan_serve(project))
     project.cache["seam_scan"] = out
     return out
 
@@ -443,6 +454,37 @@ def _scan_tune(project):
                     legacy=f"{rel}:{node.lineno}: {msg}"))
 
 
+def _scan_serve(project):
+    # SEAM012: serve/ compiles ONLY through serve/cache.py.  The cache is
+    # where donation, sentinel suppression, and hit/miss accounting live;
+    # a stray jit/lower/compile elsewhere in the package produces
+    # executables the obs events never see.
+    for rel in _slate_modules(project):
+        if not rel.startswith(SERVE_DIR + "/") or rel == SERVE_CACHE_MODULE:
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load):
+                name = node.id
+            elif isinstance(node, (ast.ImportFrom, ast.Import)):
+                aliased = [a.name for a in node.names]
+                hits = SERVE_COMPILE_NAMES.intersection(aliased)
+                if hits:
+                    name = sorted(hits)[0]
+            if name in SERVE_COMPILE_NAMES:
+                msg = (f"compiles directly (`{name}`) inside serve/ — "
+                       f"executables come ONLY from serve/cache.py "
+                       f"(ExecutableCache.get_or_compile), where donation "
+                       f"and compile accounting live")
+                yield ("SEAM012", Finding(
+                    "SEAM012", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: {msg}"))
+
+
 def legacy_report(project) -> list[str]:
     """The pre-migration checker's report lines, in its order, honoring
     per-line suppressions (the legacy checker predates suppressions, so a
@@ -489,3 +531,6 @@ _make("SEAM010", "no driver module reads the raw Option.Abft knob")
 _make("SEAM011", "the raw autotuner plan cache (load/save/cache_path/"
       "record_plan) is only touched inside slate_tpu/tune/ — consumers "
       "go through resolve_plan")
+_make("SEAM012", "serve/ obtains executables only through the serve "
+      "cache (serve/cache.py) — no jit/lower/compile elsewhere in the "
+      "package, so every serving compile is accounted")
