@@ -450,6 +450,84 @@ def bench_serve_mixed(problems, nrhs, reps, sizes):
                       "unit": "%", "n": problems}), flush=True)
 
 
+def bench_serve_ragged(problems, nrhs, reps, bucket):
+    """Ragged vs vmapped-XLA serving cores (PERF r11): one seeded
+    mixed-size workload (sizes spanning 1 .. the full bucket) through
+    two Servers on a single-rung ladder — one with Pallas plans
+    overridden onto the batch_* ops so `tune.resolve_plan` routes the
+    fast rung through the ragged batched kernels, one resolving the
+    default XLA plans (vmapped full-bucket route).  Reports raw and
+    padding-waste-adjusted problems/s per route — adjusted = raw /
+    (1 - waste), throughput per unit of LIVE work, the number the
+    ragged grids improve — plus the raw ragged/xla speedup.  Emits its
+    own lines: these metrics are problems/s, % and x, not GFLOP/s."""
+    import contextlib
+
+    from slate_tpu import obs, serve, tune
+    from slate_tpu.serve import bucket as _bucket
+    from slate_tpu.tune import TilePlan
+
+    rng = np.random.default_rng(11)
+    ops = ("solve", "chol_solve", "least_squares_solve")
+    szs = (1, max(bucket // 3, 1), max(bucket - 17, 1), bucket)
+    reqs = []
+    for i in range(problems):
+        n = int(szs[i % len(szs)])
+        op = ops[i % len(ops)]
+        dt = np.float32
+        a = rng.standard_normal((n, n)).astype(dt)
+        if op == "chol_solve":
+            a = (a @ a.T / n + np.eye(n, dtype=dt)).astype(dt)
+        elif op == "solve":
+            a = a + np.eye(n, dtype=dt) * 4.0
+        # least squares keeps m = n so all three ops share the single
+        # bucket (mb = bucket_for(m + nb - n) = the one rung)
+        b = rng.standard_normal((n, nrhs)).astype(dt)
+        reqs.append((op, a, b))
+
+    ladder = _bucket.BucketLadder((int(bucket),), "tuned")
+    plan = TilePlan("pallas", min(128, int(bucket)), 8)
+    stats = {}
+    for route in ("ragged", "xla"):
+        srv = serve.Server(ladder=ladder, cache=serve.ExecutableCache())
+        _PROGRESS["phase"] = f"compile:{route}"
+        with contextlib.ExitStack() as stack:
+            if route == "ragged":
+                for bop in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+                    stack.enter_context(tune.plan_override(bop, plan))
+            with obs.recording() as warm_events:
+                srv.serve_batch(reqs)      # compiles every bucket
+        _PROGRESS["phase"] = f"run:{route}"
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            srv.serve_batch(reqs)
+            times.append(time.perf_counter() - t0)
+        ev = [e for e in warm_events if e.get("kind") == "serve_batch"]
+        waste = (sum(e["padding_waste"] * e["problems"] for e in ev)
+                 / max(sum(e["problems"] for e in ev), 1))
+        stats[route] = (problems / min(times), float(waste))
+
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "serve_ragged_padding_waste_pct",
+                      "value": round(100.0 * stats["ragged"][1], 2),
+                      "unit": "%", "n": problems}), flush=True)
+    for route, (raw, waste) in stats.items():
+        print(json.dumps({
+            **base, "metric": f"serve_ragged_{route}_problems_per_s",
+            "value": round(float(raw), 2), "unit": "problems/s",
+            "n": problems}), flush=True)
+        print(json.dumps({
+            **base,
+            "metric": f"serve_ragged_{route}_adjusted_problems_per_s",
+            "value": round(float(raw / max(1.0 - waste, 1e-9)), 2),
+            "unit": "problems/s", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_ragged_speedup",
+                      "value": round(stats["ragged"][0]
+                                     / max(stats["xla"][0], 1e-9), 3),
+                      "unit": "x", "n": problems}), flush=True)
+
+
 QUICK_STEPS = [
     (bench_gemm, dict(n=512, nb=128, iters=4)),
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
@@ -465,6 +543,7 @@ QUICK_STEPS = [
     (bench_geqrf_panel, dict(m=512, n=128, iters=2)),
     (bench_serve_mixed, dict(problems=24, nrhs=4, reps=2,
                              sizes=(24, 48, 96))),
+    (bench_serve_ragged, dict(problems=12, nrhs=4, reps=2, bucket=32)),
 ]
 
 FULL_STEPS = [
@@ -484,6 +563,7 @@ FULL_STEPS = [
     (bench_geqrf_panel, dict(m=8192, n=256, iters=10)),
     (bench_serve_mixed, dict(problems=96, nrhs=16, reps=3,
                              sizes=(48, 96, 160, 320))),
+    (bench_serve_ragged, dict(problems=48, nrhs=16, reps=3, bucket=256)),
 ]
 
 
@@ -624,6 +704,9 @@ def sweep_nb():
         "getrf_panel": 512 if QUICK else 2048,
         "lu_select": 512 if QUICK else 2048,
         "geqrf_panel": 512 if QUICK else 8192,
+        "batch_potrf": 128 if QUICK else 256,
+        "batch_getrf": 128 if QUICK else 256,
+        "batch_geqrf": 128 if QUICK else 256,
     }
     iters = 1 if QUICK else 3
     from slate_tpu.tune import OPS

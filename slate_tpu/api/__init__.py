@@ -287,13 +287,22 @@ def svd_vals(A, opts=None):
 # landing on these same cores.
 
 
+def _full_sizes(a, live: int):
+    """Every problem in a same-shaped API stack is full-size: the sizes
+    vector the serve cores take is constant (serve.Server passes true
+    mixed sizes; here raggedness has nothing to skip)."""
+    import jax.numpy as jnp
+    return jnp.full((a.shape[0],), live, jnp.int32)
+
+
 def batch_solve(a, b, opts=None):
     """Solve A_i X_i = B_i over the leading axis: ``a`` is (batch, n, n),
     ``b`` (batch, n, k).  Returns ``(x, HealthInfo, escalated)`` with
     per-problem health and in-graph per-problem escalation (NoPiv fast
     rung -> partial-pivot LU; serve/batched.py)."""
     from ..serve import batched as _batched
-    return _batched.make_batched("solve", opts)(a, b)
+    return _batched.make_batched("solve", opts)(
+        a, b, _full_sizes(a, int(a.shape[1])))
 
 
 def batch_chol_solve(a, b, opts=None):
@@ -301,7 +310,8 @@ def batch_chol_solve(a, b, opts=None):
     holds full (symmetric) dense matrices.  Cholesky fast rung with
     per-problem LU escalation for indefinite members."""
     from ..serve import batched as _batched
-    return _batched.make_batched("chol_solve", opts)(a, b)
+    return _batched.make_batched("chol_solve", opts)(
+        a, b, _full_sizes(a, int(a.shape[1])))
 
 
 def batch_least_squares_solve(a, b, opts=None):
@@ -309,7 +319,8 @@ def batch_least_squares_solve(a, b, opts=None):
     semi-normal equations with per-problem Householder-QR escalation.
     Returns x of shape (batch, n, k)."""
     from ..serve import batched as _batched
-    return _batched.make_batched("least_squares_solve", opts)(a, b)
+    return _batched.make_batched("least_squares_solve", opts)(
+        a, b, _full_sizes(a, int(a.shape[1])))
 
 
 # ------------------------------------------------------------------ aux

@@ -29,6 +29,13 @@ then a single MXU gemm A21 U^-1 (pallas_tri.upper_tri_inv).  Both the
 pre-factor update (for the ABFT checksum rungs) and the factored panel
 are emitted.
 
+Ragged batched variant (chol_panel_batched): the same fused panel step
+with a leading batch grid dimension and a per-problem size-in-tiles
+vector delivered via scalar prefetch (PrefetchScalarGridSpec) — each
+problem computes only its own live tiles; dead tiles identity-complete
+by copying their input through, so a bucket of mixed-size problems
+never burns MXU cycles on padding.
+
 Real f32 only; complex/f64 tiles use the XLA fallback (potrf_tile).
 """
 
@@ -178,6 +185,120 @@ def chol_panel_fused(col, left, lead, bw: int = 8, interpret: bool = False):
                         pltpu.VMEM((nb, nb), col.dtype)],
         interpret=interpret,
     )(col, left, lead)
+    return upd, fac
+
+
+def _chol_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
+                               upd_ref, fac_ref, acc_ref, uinv_ref,
+                               *, k: int, bw: int):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kc = pl.num_programs(2)
+    nb = col_ref.shape[1]
+    dt = col_ref.dtype
+    # Row tile i of this panel is global tile k + i of problem b; tiles
+    # past the problem's own count are DEAD — identity-augmented packing
+    # makes their factor exactly the input tile (I on the diagonal, 0
+    # off it), so they skip every MXU dot and just copy through.
+    live = k + i < tiles_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = col_ref[0]
+
+    @pl.when(live)
+    def _update():
+        # left-looking rank-k chunk: acc -= A[b, i-tile, chunk] @ lead
+        acc_ref[:] = acc_ref[:] - jnp.dot(left_ref[0], lead_ref[0],
+                                          preferred_element_type=dt,
+                                          precision=_HI)
+
+    @pl.when(j == kc - 1)
+    def _finish():
+        @pl.when(live)
+        def _live():
+            upd_ref[0] = acc_ref[:]          # pre-factor tile (ABFT rungs)
+
+            @pl.when(i == 0)
+            def _factor():
+                _chol_factor_in_place(acc_ref, bw=bw)
+                u = acc_ref[:]
+                eye = (lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+                       == lax.broadcasted_iota(jnp.int32, (nb, nb), 1))
+                fac_ref[0] = lax.dot_general(u, eye.astype(dt),
+                                             (((0,), (0,)), ((), ())),
+                                             preferred_element_type=dt,
+                                             precision=_HI)
+                uinv_ref[:] = upper_tri_inv(u)
+
+            @pl.when(i != 0)
+            def _trsm():
+                fac_ref[0] = jnp.dot(acc_ref[:], uinv_ref[:],
+                                     preferred_element_type=dt,
+                                     precision=_HI)
+
+        @pl.when(jnp.logical_not(live))
+        def _dead():
+            upd_ref[0] = col_ref[0]
+            fac_ref[0] = col_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bw", "interpret"))
+def chol_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
+                       interpret: bool = False):
+    """Ragged batched fused Cholesky panel step.
+
+    col:   [B, M, nb] trailing block columns A[:, k0:, k0:k0+nb]
+    left:  [B, M, K]  factored block rows A[:, k0:, :k0]
+    lead:  [B, K, nb] conj(A[:, k0:k0+nb, :k0])^T per problem
+    tiles: [B] int32 per-problem live tile counts ceil(size / nb)
+    k:     static panel index (number of block columns already factored)
+
+    Per-problem-size grids via scalar prefetch: the ``tiles`` vector
+    rides ahead of the grid, row tiles at or past a problem's own count
+    copy their (identity/zero) input through untouched, and the LEFT
+    operand's index map clamps dead tiles onto the last live row so
+    their HBM->VMEM streams are never issued for fresh data.  Outputs
+    are never clamped — every block is written (dead blocks with the
+    exact identity-completion values), keeping HBM initialized.
+
+    Returns (upd, fac) stacked over B, same per-problem contract as
+    chol_panel_fused.  Caller guarantees f32, M % nb == 0, nb % bw == 0.
+    """
+    bsz, m, nb = col.shape
+    kk = left.shape[2]
+    kb = nb
+    kp = max(kb, -(-kk // kb) * kb)
+    if kk != kp:                             # pad K chunks with zeros
+        left = jnp.pad(left, ((0, 0), (0, 0), (0, kp - kk)))
+        lead = jnp.pad(lead, ((0, 0), (0, kp - kk), (0, 0)))
+    upd, fac = pl.pallas_call(
+        functools.partial(_chol_panel_batched_kernel, k=k, bw=bw),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bsz, m // nb, kp // kb),
+            in_specs=[
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+                pl.BlockSpec(
+                    (1, nb, kb),
+                    lambda b, i, j, tiles: (
+                        b,
+                        jnp.minimum(i, jnp.maximum(tiles[b] - k, 1) - 1),
+                        j)),
+                pl.BlockSpec((1, kb, nb), lambda b, i, j, tiles: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((nb, nb), col.dtype),
+                            pltpu.VMEM((nb, nb), col.dtype)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bsz, m, nb), col.dtype),
+                   jax.ShapeDtypeStruct((bsz, m, nb), col.dtype)],
+        interpret=interpret,
+    )(tiles, col, left, lead)
     return upd, fac
 
 

@@ -25,6 +25,12 @@ update), then the full-tile U^-1 (pallas_tri.upper_tri_inv) rides a
 scratch to the remaining tiles, whose TRSM is one gemm each — matching
 getrf.panel_lu_nopiv's semantics (packed L\\U, unit lower implied).
 
+Ragged batched variant (lu_panel_batched): the fused left-looking panel
+step (rank-k update + tile factor + TRSM) with a leading batch grid
+dimension and per-problem tile counts via scalar prefetch — dead tiles
+identity-complete by copying their input through, so mixed-size batches
+skip the padding work entirely.
+
 Real f32 only; the XLA LU remains the fallback (and the test oracle).
 """
 
@@ -213,6 +219,110 @@ def lu_panel_fused(panel, bw: int = 8, interpret: bool = False):
         scratch_shapes=[pltpu.VMEM((nb, nb), panel.dtype)],
         interpret=interpret,
     )(panel)
+
+
+def _lu_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
+                             upd_ref, fac_ref, acc_ref, uinv_ref,
+                             *, k: int, bw: int):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    kc = pl.num_programs(2)
+    dt = col_ref.dtype
+    # Tiles past problem b's own count are DEAD: identity-augmented
+    # packing makes their no-pivot LU exactly the input tile (the
+    # diagonal tile is I = its own packed L\\U, off-diagonal tiles are
+    # 0), so they copy through without touching the MXU.
+    live = k + i < tiles_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = col_ref[0]
+
+    @pl.when(live)
+    def _update():
+        # left-looking rank-k chunk: acc -= L[b, i-tile, chunk] @ U chunk
+        acc_ref[:] = acc_ref[:] - jnp.dot(left_ref[0], lead_ref[0],
+                                          preferred_element_type=dt,
+                                          precision=_HI)
+
+    @pl.when(j == kc - 1)
+    def _finish():
+        @pl.when(live)
+        def _live():
+            upd_ref[0] = acc_ref[:]          # pre-factor tile
+
+            @pl.when(i == 0)
+            def _factor():
+                _lu_factor_in_place(acc_ref, bw=bw)
+                fac_ref[0] = acc_ref[:]
+                uinv_ref[:] = upper_tri_inv(acc_ref[:])
+
+            @pl.when(i != 0)
+            def _trsm():
+                fac_ref[0] = jnp.dot(acc_ref[:], uinv_ref[:],
+                                     preferred_element_type=dt,
+                                     precision=_HI)   # L21 = A21 U^-1
+
+        @pl.when(jnp.logical_not(live))
+        def _dead():
+            upd_ref[0] = col_ref[0]
+            fac_ref[0] = col_ref[0]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "bw", "interpret"))
+def lu_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
+                     interpret: bool = False):
+    """Ragged batched fused no-pivot LU panel step.
+
+    col:   [B, M, nb] trailing block columns A[:, k0:, k0:k0+nb]
+    left:  [B, M, K]  packed L block rows A[:, k0:, :k0]
+    lead:  [B, K, nb] packed U block column A[:, :k0, k0:k0+nb]
+    tiles: [B] int32 per-problem live tile counts ceil(size / nb)
+    k:     static panel index
+
+    Same scalar-prefetch raggedness as chol_panel_batched: the grid adds
+    a leading batch dimension, dead row tiles (k + i >= tiles[b]) copy
+    their identity/zero input straight to both outputs, and the LEFT
+    stream's index map clamps dead tiles onto the last live row so no
+    fresh HBM->VMEM copies are issued for them.  Returns (upd, fac) with
+    lu_panel_fused's packed L\\U contract per problem (unit lower
+    implied).  Caller guarantees f32, M % nb == 0, nb % bw == 0.
+    """
+    bsz, m, nb = col.shape
+    kk = left.shape[2]
+    kb = nb
+    kp = max(kb, -(-kk // kb) * kb)
+    if kk != kp:                             # pad K chunks with zeros
+        left = jnp.pad(left, ((0, 0), (0, 0), (0, kp - kk)))
+        lead = jnp.pad(lead, ((0, 0), (0, kp - kk), (0, 0)))
+    upd, fac = pl.pallas_call(
+        functools.partial(_lu_panel_batched_kernel, k=k, bw=bw),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bsz, m // nb, kp // kb),
+            in_specs=[
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+                pl.BlockSpec(
+                    (1, nb, kb),
+                    lambda b, i, j, tiles: (
+                        b,
+                        jnp.minimum(i, jnp.maximum(tiles[b] - k, 1) - 1),
+                        j)),
+                pl.BlockSpec((1, kb, nb), lambda b, i, j, tiles: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+                pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((nb, nb), col.dtype),
+                            pltpu.VMEM((nb, nb), col.dtype)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bsz, m, nb), col.dtype),
+                   jax.ShapeDtypeStruct((bsz, m, nb), col.dtype)],
+        interpret=interpret,
+    )(tiles, col, left, lead)
+    return upd, fac
 
 
 @functools.partial(jax.jit, static_argnames=("bw", "interpret"))

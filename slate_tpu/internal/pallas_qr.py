@@ -14,6 +14,12 @@ The larfg scalar math mirrors qr.py _larfg exactly (beta =
 -copysign(mu, alpha); dead columns with mu == 0 get tau = 0 and keep
 their column), so parity tests compare against the XLA panel directly.
 
+Ragged batched variant (qr_panel_batched): one panel per grid step over
+a leading batch dimension, per-problem live row counts via scalar
+prefetch.  Unlike Cholesky/LU, padding columns carry real reflectors
+(the identity augmentation must be annihilated), so raggedness is
+problem-granular: only zero-row filler slots skip the factorization.
+
 Real f32 only, mm >= w; other panels use the XLA path (qr.geqrf_panel).
 """
 
@@ -30,20 +36,21 @@ from jax.experimental.pallas import tpu as pltpu
 _HI = lax.Precision.HIGHEST
 
 
-def _qr_panel_kernel(a_ref, p_ref, t_ref):
-    mm, w = a_ref.shape
-    dt = a_ref.dtype
+def _qr_panel_steps(a):
+    """Pure column loop shared by the single-panel kernel and the
+    batched grid: packed Householder panel + T of ``a`` [mm, w], carried
+    through the fori_loop as VALUES so it can run under pl.when."""
+    mm, w = a.shape
+    dt = a.dtype
     rows = lax.broadcasted_iota(jnp.int32, (mm, w), 0)
     cols = lax.broadcasted_iota(jnp.int32, (mm, w), 1)
     rc = lax.broadcasted_iota(jnp.int32, (mm, 1), 0)
     cn = lax.broadcasted_iota(jnp.int32, (1, w), 1)
     tc = lax.broadcasted_iota(jnp.int32, (w, w), 1)
     trc = lax.broadcasted_iota(jnp.int32, (w, 1), 0)
-    p_ref[:] = a_ref[:]
-    t_ref[:] = jnp.zeros((w, w), dt)
 
-    def col_step(j, _):
-        A = p_ref[:]
+    def col_step(j, AT):
+        A, T = AT
         colj = jnp.sum(jnp.where(cols == j, A, 0), axis=1, keepdims=True)
         alpha = jnp.sum(jnp.where(rc == j, colj, 0))
         x = jnp.where(rc > j, colj, 0.0)
@@ -64,19 +71,46 @@ def _qr_panel_kernel(a_ref, p_ref, t_ref):
         newc = jnp.where(rc == j, beta, jnp.where(rc < j, colj, x * scale))
         newc = jnp.where(live, newc, colj)           # mu==0: leave column
         A = jnp.where(cols == j, newc, A)
-        p_ref[:] = A
         # T column j: -tau T (V^T v), diag tau (larft recursion)
         V = jnp.where((rows > cols) & (cols < j), A, 0.0)
         V = V + jnp.where((rows == cols) & (cols < j), 1.0, 0.0)
         g = lax.dot_general(V, v, (((0,), (0,)), ((), ())),
                             preferred_element_type=dt, precision=_HI)
-        tcol = -tau * jnp.dot(t_ref[:], g, preferred_element_type=dt,
+        tcol = -tau * jnp.dot(T, g, preferred_element_type=dt,
                               precision=_HI)         # [w, 1]
         tcol = jnp.where(trc == j, tau, jnp.where(trc < j, tcol, 0.0))
-        t_ref[:] = jnp.where(tc == j, tcol, t_ref[:])
-        return 0
+        T = jnp.where(tc == j, tcol, T)
+        return A, T
 
-    lax.fori_loop(0, w, col_step, 0)
+    return lax.fori_loop(0, w, col_step, (a, jnp.zeros((w, w), dt)))
+
+
+def _qr_panel_kernel(a_ref, p_ref, t_ref):
+    packed, t = _qr_panel_steps(a_ref[:])
+    p_ref[:] = packed
+    t_ref[:] = t
+
+
+def _qr_panel_batched_kernel(rows_ref, a_ref, p_ref, t_ref):
+    b = pl.program_id(0)
+    w = t_ref.shape[1]
+    dt = a_ref.dtype
+    # QR raggedness is problem-granular: identity-augmented padding
+    # COLUMNS carry nontrivial reflectors (the augmented unit diagonal
+    # must be annihilated), so only problems with zero live rows —
+    # filler slots — skip the panel entirely (packed = input, T = 0).
+    live = rows_ref[b] > 0
+
+    @pl.when(live)
+    def _panel():
+        packed, t = _qr_panel_steps(a_ref[0])
+        p_ref[0] = packed
+        t_ref[0] = t
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        p_ref[0] = a_ref[0]
+        t_ref[0] = jnp.zeros((w, w), dt)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -95,4 +129,32 @@ def qr_panel_pallas(a, interpret: bool = False):
                    pl.BlockSpec(memory_space=pltpu.VMEM)],
         interpret=interpret,
     )(a)
+    return packed, t
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def qr_panel_batched(a, rows, interpret: bool = False):
+    """Ragged batched Householder panel: packed panels + Ts of ``a``
+    [B, mm, w], mm >= w, with per-problem live row counts ``rows`` [B]
+    int32 delivered via scalar prefetch.
+
+    Raggedness is problem-granular only (unlike the Cholesky/LU tile
+    grids): identity-augmented padding columns own real reflectors, so a
+    live problem factors its whole bucket panel; a problem with
+    rows[b] == 0 (a filler slot) passes its input through with T = 0.
+    Returns (packed [B, mm, w], T [B, w, w])."""
+    bsz, mm, w = a.shape
+    packed, t = pl.pallas_call(
+        _qr_panel_batched_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bsz,),
+            in_specs=[pl.BlockSpec((1, mm, w), lambda b, rows: (b, 0, 0))],
+            out_specs=[pl.BlockSpec((1, mm, w), lambda b, rows: (b, 0, 0)),
+                       pl.BlockSpec((1, w, w), lambda b, rows: (b, 0, 0))],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bsz, mm, w), a.dtype),
+                   jax.ShapeDtypeStruct((bsz, w, w), a.dtype)],
+        interpret=interpret,
+    )(rows, a)
     return packed, t

@@ -162,14 +162,17 @@ def summarize_bench(bench) -> dict:
 
 def summarize_serve(serve) -> dict:
     """Serving table: per (op, dtype) batch counts, bucket occupancy
-    percentiles, padding waste, escalations per 1k problems, and the
-    retrace/compile accounting that proves a warmed server stays warm."""
+    percentiles, padding waste, escalations per 1k problems, the
+    retrace/compile accounting that proves a warmed server stays warm,
+    and ``wa_pps`` — padding-waste-adjusted problems/s, raw throughput
+    over the batch durations divided by (1 - waste): throughput per
+    unit of LIVE work, the number the ragged serving cores improve."""
     table: dict[str, dict] = {}
     for e in serve:
         key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
         s = table.setdefault(key, {
             "batches": 0, "problems": 0, "escalated": 0, "compiles": 0,
-            "retraces": 0, "_occ": [], "_waste": []})
+            "retraces": 0, "_occ": [], "_waste": [], "_dur_ms": 0.0})
         s["batches"] += 1
         s["problems"] += int(e.get("problems") or 0)
         s["escalated"] += int(e.get("escalated") or 0)
@@ -179,13 +182,19 @@ def summarize_serve(serve) -> dict:
             s["_occ"].append(float(e["occupancy"]))
         if isinstance(e.get("padding_waste"), (int, float)):
             s["_waste"].append(float(e["padding_waste"]))
+        if isinstance(e.get("dur_ms"), (int, float)):
+            s["_dur_ms"] += float(e["dur_ms"])
     for s in table.values():
         occ, waste = s.pop("_occ"), s.pop("_waste")
+        dur_s = s.pop("_dur_ms") / 1e3
         s["occupancy_p50"] = percentile(occ, 50)
         s["occupancy_p99"] = percentile(occ, 99)
         s["padding_waste_p50"] = percentile(waste, 50)
         probs = max(s["problems"], 1)
         s["esc_per_1k"] = round(1000.0 * s["escalated"] / probs, 2)
+        w = s["padding_waste_p50"] or 0.0
+        s["wa_pps"] = (round(s["problems"] / dur_s / max(1.0 - w, 1e-9), 2)
+                       if dur_s > 0 else None)
     return dict(sorted(table.items()))
 
 
@@ -249,11 +258,13 @@ def render(summary: dict) -> str:
     if summary.get("serve"):
         rows = [[key, s["batches"], s["problems"], s["occupancy_p50"],
                  s["occupancy_p99"], s["padding_waste_p50"],
-                 s["esc_per_1k"], s["retraces"], s["compiles"]]
+                 s.get("wa_pps"), s["esc_per_1k"], s["retraces"],
+                 s["compiles"]]
                 for key, s in summary["serve"].items()]
         parts.append("\nserving\n" + _table(
             ["op/dtype", "batches", "problems", "occ_p50", "occ_p99",
-             "waste_p50", "esc/1k", "retraces", "compiles"], rows))
+             "waste_p50", "wa_pps", "esc/1k", "retraces", "compiles"],
+            rows))
     bench = summary["bench"]
     if bench["metrics"]:
         rows = [[m, d.get("value"), d.get("unit"), d.get("mfu"),

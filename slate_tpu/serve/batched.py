@@ -21,16 +21,30 @@ Every core returns ``(x_dense, HealthInfo, escalated)``; vmapped, the
 HealthInfo comes back as a leading-axis pytree (one scalar per problem
 per field — including the per-problem ABFT counters when
 ``Option.Abft`` is on) and ``escalated`` as a per-problem bool.
+
+Ragged fast rungs: when the tune/ plan cache resolves a Pallas plan for
+``batch_potrf`` / ``batch_getrf`` / ``batch_geqrf`` at the bucket size
+(`_ragged_plan`), the batch's fast rung runs as ONE ragged batched
+Pallas factorization (internal/batched.py) whose grid consumes the
+per-problem size vector via scalar prefetch — each problem computes
+only its own tiles instead of the full identity-padded bucket.  The
+escalation ladder is unchanged: the batched fast-rung health feeds the
+same per-problem ``lax.cond`` (`_vmap_escalate`), whose safe rung is
+the identical per-problem driver attempt.  A plan miss (or non-f32, or
+an option the ragged rung does not implement) falls back to the
+vmapped cores; both routes share one ``fn(a, b, sizes)`` executable
+signature, so routing never costs the warm server a retrace.
 """
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
-from ..options import ErrorPolicy, Option, Options
+from ..options import ErrorPolicy, Option, Options, resolve_abft
 from ..robust import health as _h
 from ..types import Uplo
 
@@ -163,10 +177,168 @@ CORES = {
     "least_squares_solve": least_squares_core,
 }
 
+# ------------------------------------------------------------ ragged route
+
+# plan-cache op implementing each serve op's fast rung as ONE ragged
+# batched Pallas factorization (internal/batched.py) instead of a
+# vmapped full-bucket XLA driver
+RAGGED_OPS = {
+    "solve": "batch_getrf",
+    "chol_solve": "batch_potrf",
+    "least_squares_solve": "batch_geqrf",
+}
+
+
+def _interpret() -> bool:
+    # slate-lint: disable=TRC001 -- capability probe: backend kind is host-static, never tracer data
+    return jax.default_backend() != "tpu"
+
+
+def _ragged_plan(op: str, a: jax.Array, opts: Options | None):
+    """The measured routing decision, taken at TRACE time from static
+    shape/dtype/plan data: the ragged batched kernel runs only when the
+    tune/ plan cache (or a plan_override) hands back a Pallas plan for
+    this op's batch kernel at this bucket size — `tune.resolve_plan` is
+    the ONLY selection seam (SEAM011), exactly as for the single-shot
+    drivers.  Returns the plan with nb clamped to the bucket, or None
+    for the vmapped-XLA fallback (plan miss, non-f32, or an option the
+    ragged rung does not implement)."""
+    from .. import tune as _tune
+    nb_bucket = int(a.shape[2] if op == "least_squares_solve"
+                    else a.shape[1])
+    if str(a.dtype) != "float32":
+        return None
+    if resolve_abft(opts) and op != "chol_solve":
+        # only batch_potrf carries the checksum rungs in-batch; the
+        # other ops honor Abft through the vmapped driver cores
+        return None
+    plan = _tune.resolve_plan(RAGGED_OPS[op], nb_bucket, str(a.dtype))
+    if plan.kernel != "pallas":
+        return None
+    nb = min(int(plan.nb), nb_bucket)
+    if nb_bucket % nb or nb % max(int(plan.bw), 8):
+        return None
+    return plan._replace(nb=nb)
+
+
+def _vmap_escalate(h1, x1, safe, operands, dtype):
+    """Batched escalation seam: per-problem lax.cond against the ragged
+    fast rung's batched health — identical branch pytrees to the
+    vmapped cores', so escalating problems get exactly the safe rung
+    they would have gotten on the vmapped route."""
+    return jax.vmap(
+        lambda h1i, x1i, *ops: _cond_escalate(h1i, x1i, safe, ops, dtype)
+    )(h1, x1, *operands)
+
+
+def _ragged_solve(a, b, sizes, plan, opts: Options | None):
+    """solve fast rung via batch_getrf (ragged NoPiv LU + 2 IR sweeps),
+    safe rung the per-problem partial-pivot LU."""
+    from ..drivers import lu as _lu
+    from ..internal import batched as _bk
+    t = _tile(a.shape[1])
+    o = _info(opts)
+    fa = _bk.batch_getrf(a, sizes, nb=plan.nb, bw=plan.bw,
+                         interpret=_interpret())
+    x = _bk.batch_getrs(fa, b)
+    for _ in range(2):                     # r = b - A x, dx through fa
+        x = x + _bk.batch_getrs(fa, b - a @ x)
+    h1 = _h.merge(_bk.batch_lu_health(a, fa),
+                  jax.vmap(_h.from_result)(x))
+    h1 = _demote(h1, a.dtype)
+
+    def safe(ops):
+        ad, bd = ops
+        F, fh = _lu.getrf(_mat(ad, t), o)
+        xd = _lu.getrs(F, _mat(bd, t), o).to_dense()
+        h = _h.merge(fh, _h.from_result(xd))
+        return xd, _demote(h, ad.dtype)
+
+    return _vmap_escalate(h1, x, safe, (a, b), a.dtype)
+
+
+def _ragged_chol(a, b, sizes, plan, opts: Options | None):
+    """chol_solve fast rung via batch_potrf (with the in-batch ABFT
+    rungs when Option.Abft is on), safe rung the per-problem
+    partial-pivot LU — the same ladder as chol_solve_core."""
+    from ..drivers import lu as _lu
+    from ..internal import batched as _bk
+    t = _tile(a.shape[1])
+    o = _info(opts)
+    fa, counts = _bk.batch_potrf(a, sizes, nb=plan.nb, bw=plan.bw,
+                                 interpret=_interpret(),
+                                 abft=resolve_abft(opts))
+    y = lax.linalg.triangular_solve(fa, b, left_side=True, lower=True)
+    x = lax.linalg.triangular_solve(fa, y, left_side=True, lower=True,
+                                    transpose_a=True)
+    h1 = _bk.batch_chol_health(fa)._replace(
+        abft_detected=counts.detected, abft_corrected=counts.corrected,
+        abft_site=counts.site)
+    h1 = _demote(_h.merge(h1, jax.vmap(_h.from_result)(x)), a.dtype)
+
+    def lu(ops):
+        ad, bd = ops
+        F, fh = _lu.getrf(_mat(ad, t), o)
+        X = _lu.getrs(F, _mat(bd, t), o)
+        h = _h.merge(fh, _h.from_result(X.storage.data))
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    return _vmap_escalate(h1, x, lu, (a, b), a.dtype)
+
+
+def _ragged_lstsq(a, b, sizes, plan, opts: Options | None):
+    """least_squares_solve fast rung via batch_geqrf (ragged Householder
+    QR — rank-revealing on |diag R|), safe rung the per-problem
+    Householder QR driver."""
+    from ..drivers import qr as _qr
+    from ..internal import batched as _bk
+    nb = a.shape[2]
+    t = _tile(nb)
+    o = _info(opts)
+    x, packed = _bk.batch_gels(a, b, sizes, nb=plan.nb,
+                               interpret=_interpret())
+
+    def hone(p, xi):
+        d = jnp.abs(jnp.diagonal(p[:nb, :nb]))
+        return _h.merge(_h.from_pivots(d), _h.from_result(xi))
+
+    h1 = _demote(jax.vmap(hone)(packed, x), a.dtype)
+
+    def house(ops):
+        ad, bd = ops
+        X, h = _qr._gels_qr_attempt(_mat(ad, t), _mat(bd, t), o)
+        return X.to_dense(), _demote(h, ad.dtype)
+
+    return _vmap_escalate(h1, x, house, (a, b), a.dtype)
+
+
+RAGGED_CORES = {
+    "solve": _ragged_solve,
+    "chol_solve": _ragged_chol,
+    "least_squares_solve": _ragged_lstsq,
+}
+
 
 def make_batched(op: str, opts: Options | None = None):
-    """The leading-axis-batched core for one op: vmap over problems.
-    ``opts`` is closed over as static configuration (it participates in
-    the executable-cache fingerprint, never in the traced data)."""
+    """The leading-axis-batched core for one op: ``fn(a, b, sizes)``.
+
+    ``sizes`` is the per-problem live-size vector ([B] int32: n for
+    square solves, m + (nb - n) live rows for least squares, 0 for
+    filler slots).  At trace time `_ragged_plan` consults the tune/
+    plan cache: a Pallas plan routes the fast rung through the ragged
+    batched kernels (each problem computes only its own tiles), a miss
+    vmaps the per-problem cores over the full bucket — which ignore
+    ``sizes`` entirely, so both routes share one executable signature
+    and the warm server stays retrace-free whichever is picked.  ``opts``
+    is closed over as static configuration (it participates in the
+    executable-cache fingerprint, never in the traced data)."""
     core = CORES[op]
-    return jax.vmap(lambda a, b: core(a, b, opts))
+
+    def fn(a, b, sizes):
+        plan = _ragged_plan(op, a, opts)
+        if plan is not None:
+            return RAGGED_CORES[op](a, b, sizes, plan, opts)
+        del sizes                          # vmapped route pads to bucket
+        return jax.vmap(lambda ai, bi: core(ai, bi, opts))(a, b)
+
+    return fn

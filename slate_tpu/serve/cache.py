@@ -75,9 +75,12 @@ class ExecutableCache:
         ``bucket_shape`` is ``(nb, kb)`` for square solves or
         ``(mb, nb, kb)`` for least squares; ``batch`` the (bucketed)
         problem count.  The executable maps packed stacks
-        ``(a [batch, ...], b [batch, mb|nb, kb])`` to
-        ``(x, HealthInfo, escalated)`` with leading axis ``batch``,
-        donating ``b``."""
+        ``(a [batch, ...], b [batch, mb|nb, kb], sizes [batch] int32)``
+        to ``(x, HealthInfo, escalated)`` with leading axis ``batch``,
+        donating ``b``.  ``sizes`` carries per-problem live sizes as
+        TRACED data — the ragged kernels consume it via scalar
+        prefetch, the vmapped fallback ignores it — so mixed-size
+        batches never alter the executable's static signature."""
         dtype = str(jax.numpy.dtype(dtype))
         key = (op, tuple(int(s) for s in bucket_shape), dtype,
                options_fingerprint(opts), int(batch))
@@ -104,6 +107,7 @@ class ExecutableCache:
             mb = nb
         a_spec = jax.ShapeDtypeStruct((batch, mb, nb), dtype)
         b_spec = jax.ShapeDtypeStruct((batch, mb, kb), dtype)
+        s_spec = jax.ShapeDtypeStruct((batch,), "int32")
         fn = _batched.make_batched(op, opts)
         # donate b only where the result aliases it exactly: a square
         # solve's x has b's shape, least squares returns (nb, kb) != b
@@ -114,7 +118,7 @@ class ExecutableCache:
         # account the compile as ONE serve-level trace instead
         with _sentinel.suppressed():
             exe = jax.jit(fn, donate_argnums=donate).lower(
-                a_spec, b_spec).compile()
+                a_spec, b_spec, s_spec).compile()
         _sentinel.record_trace(
             f"serve.{op}", f"{dtype}:b{batch}:"
             + "x".join(str(s) for s in bucket_shape))

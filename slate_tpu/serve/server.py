@@ -152,14 +152,20 @@ class Server:
             mb = nb
         a_pad = np.zeros((batch, mb, nb), dtype)
         b_pad = np.zeros((batch, mb, kb), dtype)
+        # per-problem live sizes, TRACED data for the ragged kernels:
+        # n for square slots, m + (nb - n) live augmented rows for least
+        # squares, 0 for filler slots (batched.make_batched's contract)
+        sizes = np.zeros((batch,), np.int32)
         real_elems = 0
         for slot, (_, req) in enumerate(members):
+            m_i, n_i = req.a.shape
             if op == "least_squares_solve":
                 a_pad[slot] = _bucket.pad_tall(jnp.asarray(req.a), mb, nb)
+                sizes[slot] = m_i + (nb - n_i)
             else:
                 a_pad[slot] = _bucket.pad_square(jnp.asarray(req.a), nb)
+                sizes[slot] = n_i
             b_pad[slot] = _bucket.pad_rows(jnp.asarray(req.b), mb, kb)
-            m_i, n_i = req.a.shape
             real_elems += m_i * n_i + m_i * req.b.shape[1]
         for slot in range(n_real, batch):          # identity filler slots
             a_pad[slot, :nb, :nb] = np.eye(nb, dtype=dtype)
@@ -169,7 +175,8 @@ class Server:
                                              self.opts)
         # b is DONATED to the executable (cache.py's contract): hand it
         # a fresh device array and never touch that buffer again
-        x, h, esc = exe(jnp.asarray(a_pad), jnp.asarray(b_pad))
+        x, h, esc = exe(jnp.asarray(a_pad), jnp.asarray(b_pad),
+                        jnp.asarray(sizes))
         x = np.asarray(x)
         esc = np.asarray(esc)
         h_np = HealthInfo(*(np.asarray(leaf) for leaf in h))

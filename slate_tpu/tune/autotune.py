@@ -40,7 +40,7 @@ def candidates(op: str, n: int, dtype: str = "float32") -> list[TilePlan]:
     else:
         nbs = [nb for nb in CANDIDATE_NB if nb <= n and n % nb == 0]
     for nb in nbs:
-        if op == "geqrf_panel":       # no bw knob in the QR kernel
+        if op in ("geqrf_panel", "batch_geqrf"):  # no bw knob in QR kernels
             plans.append(TilePlan("pallas", nb, 8))
             continue
         plans.extend(TilePlan("pallas", nb, bw) for bw in CANDIDATE_BW
@@ -123,6 +123,63 @@ def _problem(op: str, plan: TilePlan, n: int):
             fn = jax.jit(qr.householder_panel_blocked)
         return (lambda: fn(panel)), 2 * n * nb ** 2
 
+    if op in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+        # Representative ragged bucket: B identity-augmented slots whose
+        # live sizes sweep the bucket (serve/server.py's packing), flops
+        # counted over LIVE work only so both routes report waste-adjusted
+        # throughput against the same denominator.
+        from ..internal import batched
+
+        B = 8
+        sizes = np.asarray([max(1, ((i + 1) * n) // B) for i in range(B)],
+                           np.int32)
+        a = np.zeros((B, n, n), np.float32)
+        for i, s in enumerate(sizes):
+            s = int(s)
+            g = rng.standard_normal((s, s)).astype(np.float32)
+            if op == "batch_potrf":
+                a[i, :s, :s] = g @ g.T + s * np.eye(s, dtype=np.float32)
+            elif op == "batch_getrf":
+                a[i, :s, :s] = g + s * np.eye(s, dtype=np.float32)
+            else:
+                a[i, :s, :s] = g
+            idx = np.arange(s, n)
+            a[i, idx, idx] = 1.0                 # identity augmentation
+        live = sizes.astype(np.float64)
+        if op == "batch_geqrf":
+            # problem-granular raggedness: live slots factor the whole
+            # bucket panel (padding columns own real reflectors), slot 0
+            # is a zero filler the kernel passes through
+            sizes = np.where(np.arange(B) == 0, 0, n).astype(np.int32)
+            a[0] = 0.0
+            flops = 2 * n ** 3 / 3 * int((sizes > 0).sum())
+        elif op == "batch_potrf":
+            flops = float((live ** 3).sum()) / 3
+        else:
+            flops = 2 * float((live ** 3).sum()) / 3
+        aj, sj = jnp.asarray(a), jnp.asarray(sizes)
+        if op == "batch_potrf":
+            if pallas:
+                fn = jax.jit(lambda x, s: batched.batch_potrf(
+                    x, s, nb=nb, bw=plan.bw, interpret=interp)[0])
+            else:
+                fn = jax.jit(lambda x, s: jax.vmap(jnp.linalg.cholesky)(x))
+        elif op == "batch_getrf":
+            if pallas:
+                fn = jax.jit(lambda x, s: batched.batch_getrf(
+                    x, s, nb=nb, bw=plan.bw, interpret=interp))
+            else:
+                fn = jax.jit(lambda x, s: jax.vmap(
+                    lambda xi: jax.lax.linalg.lu(xi)[0])(x))
+        else:
+            if pallas:
+                fn = jax.jit(lambda x, s: batched.batch_geqrf(
+                    x, s, nb=nb, interpret=interp)[0])
+            else:
+                fn = jax.jit(lambda x, s: jax.vmap(
+                    lambda xi: jnp.linalg.qr(xi, mode="r"))(x))
+        return (lambda: fn(aj, sj)), flops
+
     raise ValueError(f"unknown op {op!r}")
 
 
@@ -167,3 +224,91 @@ def tune_all(ns=(256, 512, 1024), ops=OPS, dtype: str = "float32",
             out[(op, n)] = tune_op(op, n, dtype, iters=iters,
                                    persist=persist)
     return out
+
+
+# -------------------------------------------------- serve_bucket ladder
+#
+# Not a kernel sweep: the ``serve_bucket`` pseudo-op records the bucket
+# LADDER for this chip from a recorded request-size histogram.  Rungs
+# are chosen to minimize total padded area (sum over requests of
+# rung^2) by dynamic programming over the distinct tile-rounded sizes,
+# then persisted one cache entry per rung so serve.bucket.default_ladder
+# picks them up through tune.serve_buckets.
+
+
+def serve_ladder_from_sizes(sizes, max_rungs: int = 8,
+                            base: int = 32) -> tuple:
+    """Padded-area-optimal bucket ladder (<= ``max_rungs`` rungs) for a
+    request-size sample.  Sizes round up to ``base`` multiples (the tile
+    edge — finer rungs cannot change the packed shapes); each rung must
+    be one of the distinct rounded sizes and the top rung covers the
+    largest, so every recorded request buckets without doubling."""
+    import collections
+
+    pad = [max(base, -(-int(s) // base) * base) for s in sizes if int(s) > 0]
+    if not pad:
+        raise ValueError("serve_ladder_from_sizes: no positive sizes")
+    hist = collections.Counter(pad)
+    edges = sorted(hist)
+    ne = len(edges)
+    if ne <= max_rungs:
+        return tuple(edges)
+    # cost[lo][hi]: every request in edges[lo..hi] served at edges[hi]
+    cost = [[0.0] * ne for _ in range(ne)]
+    for lo in range(ne):
+        cnt = 0
+        for hi in range(lo, ne):
+            cnt += hist[edges[hi]]
+            cost[lo][hi] = cnt * edges[hi] ** 2
+    inf = float("inf")
+    dp = [[inf] * ne for _ in range(max_rungs + 1)]
+    cut = [[-1] * ne for _ in range(max_rungs + 1)]
+    for hi in range(ne):
+        dp[1][hi] = cost[0][hi]
+    for r in range(2, max_rungs + 1):
+        for hi in range(r - 1, ne):
+            for mid in range(r - 2, hi):
+                c = dp[r - 1][mid] + cost[mid + 1][hi]
+                if c < dp[r][hi]:
+                    dp[r][hi] = c
+                    cut[r][hi] = mid
+    best_r = min(range(1, max_rungs + 1), key=lambda r: dp[r][ne - 1])
+    rungs, r, hi = [], best_r, ne - 1
+    while r > 1:
+        rungs.append(edges[hi])
+        hi = cut[r][hi]
+        r -= 1
+    rungs.append(edges[hi])
+    return tuple(sorted(rungs))
+
+
+def ladder_waste(sizes, ladder) -> float:
+    """Padding waste (1 - live/padded area) of serving ``sizes`` square
+    problems on ``ladder`` (a serve.bucket.BucketLadder)."""
+    live = padded = 0
+    for s in sizes:
+        s = int(s)
+        if s <= 0:
+            continue
+        b = ladder.bucket_for(s)
+        live += s * s
+        padded += b * b
+    return 1.0 - live / padded if padded else 0.0
+
+
+def tune_serve_buckets(sizes, dtype: str = "float32", max_rungs: int = 8,
+                       persist: bool = True):
+    """Fit a bucket ladder to a request-size histogram and persist it as
+    ``serve_bucket`` plan-cache entries (one per rung).  Returns
+    ``(rungs, waste_geometric, waste_tuned)`` so the CLI can report the
+    padding-waste improvement over the geometric default."""
+    from ..serve import bucket as _bucket
+    from .plans import SERVE_BUCKET_OP, XLA_PLAN
+
+    rungs = serve_ladder_from_sizes(sizes, max_rungs=max_rungs)
+    w_geo = ladder_waste(sizes, _bucket.geometric_ladder())
+    w_tuned = ladder_waste(sizes, _bucket.BucketLadder(rungs, "tuned"))
+    if persist:
+        for r in rungs:
+            record_plan(SERVE_BUCKET_OP, int(r), dtype, XLA_PLAN)
+    return rungs, w_geo, w_tuned

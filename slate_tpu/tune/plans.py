@@ -42,7 +42,7 @@ from typing import NamedTuple
 
 SCHEMA_VERSION = 1
 OPS = ("potrf_tile", "potrf_panel", "getrf_panel", "lu_select",
-       "geqrf_panel")
+       "geqrf_panel", "batch_potrf", "batch_getrf", "batch_geqrf")
 # The serving layer's bucket ladder rides the same cache file but is NOT a
 # kernel-tuning op (no candidate sweep): each recorded entry's ``n`` is one
 # ladder rung for this chip (see serve_buckets / docs/SERVING.md).

@@ -1,0 +1,248 @@
+"""Ragged batched Pallas factorization tests (internal/batched.py and the
+batched panel kernels in internal/pallas_{chol,lu,qr}.py), interpret mode
+on CPU.
+
+The load-bearing guarantees:
+
+- each batched panel step matches the single-problem fused kernel it
+  generalizes (chol_panel_batched vs chol_panel_fused, incl. k > 0);
+- the blocked drivers match per-problem XLA references over MIXED live
+  sizes — ragged edges inside a tile, size-1 members, full-bucket
+  members — and keep the identity-augmented padding region EXACT
+  (dead tiles copy their input through: bit-identical, not just close);
+- filler slots (size 0) pass through untouched;
+- the ABFT checksum rungs detect and repair a single injected strike
+  THROUGH a batched panel, and the repaired factor matches the clean run.
+
+The kernels are real-f32-only by contract (the serve router gates dtype);
+everything here runs them via ``interpret=True`` so tier-1 covers the
+exact lowering the TPU executes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.internal import batched
+
+RTOL, ATOL = 2e-4, 2e-3
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def _spd_stack(rng, n, sizes):
+    """Identity-augmented SPD slots [B, n, n] (serve pad_square packing)."""
+    a = np.zeros((len(sizes), n, n), np.float32)
+    for i, s in enumerate(sizes):
+        if s:
+            g = rng.standard_normal((s, s)).astype(np.float32)
+            a[i, :s, :s] = g @ g.T + s * np.eye(s, dtype=np.float32)
+        idx = np.arange(s, n)
+        if s:                              # size-0 filler slots stay zero
+            a[i, idx, idx] = 1.0
+    return a
+
+
+def _dd_stack(rng, n, sizes):
+    """Identity-augmented diagonally-dominant slots (NoPiv-LU-safe)."""
+    a = np.zeros((len(sizes), n, n), np.float32)
+    for i, s in enumerate(sizes):
+        if s:
+            g = rng.standard_normal((s, s)).astype(np.float32)
+            a[i, :s, :s] = g + s * np.eye(s, dtype=np.float32)
+            idx = np.arange(s, n)
+            a[i, idx, idx] = 1.0
+    return a
+
+
+# ------------------------------------------------- panel-level parity
+
+
+def test_chol_panel_batched_matches_fused(rng):
+    """A full-size batch member's panel step is the single-problem fused
+    panel, at k = 0 and at k > 0 (nonzero left history)."""
+    from slate_tpu.internal.pallas_chol import (chol_panel_batched,
+                                                chol_panel_fused)
+    n, nb = 64, 32
+    a = _spd_stack(rng, n, [n, n])
+    fa = jnp.asarray(a)
+    for k in range(n // nb):
+        k0, k1 = k * nb, (k + 1) * nb
+        col = fa[:, k0:, k0:k1]
+        left = fa[:, k0:, :k0]
+        lead = jnp.swapaxes(fa[:, k0:k1, :k0], 1, 2)
+        tiles = jnp.asarray([n // nb, n // nb], jnp.int32)
+        upd, fac = chol_panel_batched(col, left, lead, tiles, k=k, bw=8,
+                                      interpret=True)
+        for b in range(2):
+            ru, rf = chol_panel_fused(col[b], left[b], lead[b], bw=8,
+                                      interpret=True)
+            np.testing.assert_allclose(np.asarray(upd[b]), np.asarray(ru),
+                                       rtol=RTOL, atol=ATOL)
+            np.testing.assert_allclose(np.asarray(fac[b]), np.asarray(rf),
+                                       rtol=RTOL, atol=ATOL)
+        fa = fa.at[:, k0:, k0:k1].set(fac)
+
+
+# ------------------------------------------------- blocked driver parity
+
+
+def test_batch_potrf_mixed_sizes(rng):
+    """Parity vs per-problem np.linalg.cholesky at ragged sizes (inside a
+    tile, size 1, full bucket), EXACT identity padding, exact filler
+    passthrough."""
+    n, nb = 64, 32
+    sizes = [1, 40, 64, 0]
+    a = _spd_stack(rng, n, sizes)
+    fa, counts = batched.batch_potrf(jnp.asarray(a),
+                                     jnp.asarray(sizes, jnp.int32),
+                                     nb=nb, bw=8, interpret=True)
+    fa = np.asarray(fa)
+    for b, s in enumerate(sizes):
+        if s == 0:
+            np.testing.assert_array_equal(fa[b], a[b])  # filler: untouched
+            continue
+        ref = np.linalg.cholesky(a[b, :s, :s].astype(np.float64))
+        np.testing.assert_allclose(np.tril(fa[b, :s, :s]), ref,
+                                   rtol=RTOL, atol=ATOL)
+        # padding region of the factor is EXACTLY blockdiag(. , I)
+        pad = np.tril(fa[b])[s:, :]
+        np.testing.assert_array_equal(pad[:, :s], 0.0)
+        np.testing.assert_array_equal(pad[:, s:], np.eye(n - s,
+                                                         dtype=np.float32))
+    assert int(np.asarray(counts.detected).sum()) == 0
+
+
+def test_batch_getrf_mixed_sizes(rng):
+    """Reconstruction L @ U = A per live problem, exact padding, and
+    batch_getrs against np.linalg.solve."""
+    n, nb = 64, 32
+    sizes = [1, 40, 64, 0]
+    a = _dd_stack(rng, n, sizes)
+    sz = jnp.asarray(sizes, jnp.int32)
+    fa = np.asarray(batched.batch_getrf(jnp.asarray(a), sz, nb=nb, bw=8,
+                                        interpret=True))
+    b_rhs = rng.standard_normal((len(sizes), n, 3)).astype(np.float32)
+    x = np.asarray(batched.batch_getrs(jnp.asarray(fa),
+                                       jnp.asarray(b_rhs)))
+    for b, s in enumerate(sizes):
+        if s == 0:
+            np.testing.assert_array_equal(fa[b], a[b])
+            continue
+        L = np.tril(fa[b], -1) + np.eye(n, dtype=np.float32)
+        U = np.triu(fa[b])
+        np.testing.assert_allclose(L @ U, a[b], rtol=RTOL,
+                                   atol=ATOL * max(s, 1))
+        np.testing.assert_array_equal(fa[b, s:, :s], 0.0)
+        np.testing.assert_array_equal(fa[b, :s, s:], 0.0)
+        np.testing.assert_array_equal(fa[b, s:, s:],
+                                      np.eye(n - s, dtype=np.float32))
+        ref = np.linalg.solve(a[b].astype(np.float64),
+                              b_rhs[b].astype(np.float64))
+        np.testing.assert_allclose(x[b], ref, rtol=5e-3, atol=5e-3)
+
+
+def test_batch_geqrf_gels_mixed_sizes(rng):
+    """batch_gels matches per-problem np.linalg.lstsq through the serve
+    packing (pad_tall identity augmentation), with zero-row filler slots
+    passing through untouched."""
+    mb, nbq, w = 24, 16, 8
+    # member 0: (m=4, n=3) augmented -> 17 live rows; member 1: full
+    # (24, 16); member 2: filler (rows = 0, zero slot)
+    probs = [(4, 3), (mb, nbq), None]
+    rows = []
+    a = np.zeros((len(probs), mb, nbq), np.float32)
+    b = np.zeros((len(probs), mb, 2), np.float32)
+    for i, p in enumerate(probs):
+        if p is None:
+            rows.append(0)
+            continue
+        m, nn = p
+        ai = rng.standard_normal((m, nn)).astype(np.float32)
+        bi = rng.standard_normal((m, 2)).astype(np.float32)
+        a[i, :m, :nn] = ai
+        extra = nbq - nn
+        a[i, m:m + extra, nn:] = np.eye(extra, dtype=np.float32)
+        b[i, :m] = bi
+        rows.append(m + extra)
+    x, packed = batched.batch_gels(jnp.asarray(a), jnp.asarray(b),
+                                   jnp.asarray(rows, jnp.int32),
+                                   nb=w, interpret=True)
+    x, packed = np.asarray(x), np.asarray(packed)
+    for i, p in enumerate(probs):
+        if p is None:
+            np.testing.assert_array_equal(packed[i], a[i])  # filler
+            continue
+        m, nn = p
+        ref = np.linalg.lstsq(a[i, :rows[i]].astype(np.float64),
+                              b[i, :rows[i]].astype(np.float64),
+                              rcond=None)[0]
+        np.testing.assert_allclose(x[i, :nn], ref[:nn], rtol=5e-3,
+                                   atol=5e-3)
+        # padding solution components decouple to ~0
+        np.testing.assert_allclose(x[i, nn:], 0.0, atol=1e-4)
+
+
+# --------------------------------------------------------- ABFT in-batch
+
+
+def test_batch_potrf_abft_single_strike(rng):
+    """A transient post_panel bitflip through a BATCHED panel is detected
+    and repaired: counters report exactly one event and the factor
+    matches the clean run."""
+    from slate_tpu.robust import faults
+    n, nb = 64, 32
+    sizes = [40, 64, 0]
+    a = _spd_stack(rng, n, sizes)
+    aj = jnp.asarray(a)
+    sz = jnp.asarray(sizes, jnp.int32)
+    clean, c0 = batched.batch_potrf(aj, sz, nb=nb, bw=8, interpret=True,
+                                    abft=True)
+    clean = np.asarray(clean)
+    assert int(np.asarray(c0.detected).sum()) == 0
+
+    # the transient strike fires on panel 0's factored fac [B, n, nb]; a
+    # bitflip on an exact-zero padding/upper-half element is a no-op, so
+    # pick the first seed whose flat index lands on a nonzero element
+    panel0 = clean[:, :, :nb].ravel()
+    seed = next(s for s in range(200) if abs(panel0[
+        np.random.default_rng(s).choice(panel0.size, 1,
+                                        replace=False)[0]]) > 1e-3)
+    plan = faults.FaultPlan("post_panel", kind="bitflip", seed=seed,
+                            transient=True)
+    with faults.inject(plan):
+        hit, counts = batched.batch_potrf(aj, sz, nb=nb, bw=8,
+                                          interpret=True, abft=True)
+    det = np.asarray(counts.detected)
+    cor = np.asarray(counts.corrected)
+    assert int(det.sum()) == 1 and int(cor.sum()) == 1
+    np.testing.assert_allclose(np.asarray(hit), clean, rtol=1e-4,
+                               atol=1e-4)
+
+
+# ------------------------------------------------------- health helpers
+
+
+def test_batch_health_mirrors_drivers(rng):
+    """Padding diagonal entries are exactly 1 and never mask a genuine
+    failure: an indefinite live block reads not-ok, healthy slots ok."""
+    n, nb = 64, 32
+    sizes = [40, 64]
+    a = _spd_stack(rng, n, sizes)
+    a[0, 1, 1] = -50.0                      # indefinite -> NaN in L
+    fa, _ = batched.batch_potrf(jnp.asarray(a),
+                                jnp.asarray(sizes, jnp.int32),
+                                nb=nb, bw=8, interpret=True)
+    h = batched.batch_chol_health(fa)
+    ok = np.asarray(h.ok)
+    assert not bool(ok[0]) and bool(ok[1])
+
+    ad = _dd_stack(rng, n, sizes)
+    fd = batched.batch_getrf(jnp.asarray(ad), jnp.asarray(sizes,
+                                                          jnp.int32),
+                             nb=nb, bw=8, interpret=True)
+    hd = batched.batch_lu_health(jnp.asarray(ad), fd)
+    assert bool(np.asarray(hd.ok).all())

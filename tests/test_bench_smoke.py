@@ -82,6 +82,32 @@ def test_serve_mixed_emits_throughput_and_waste(bench, capsys):
     assert 0.0 <= waste["value"] <= 100.0
 
 
+def test_serve_ragged_emits_both_routes(bench, capsys):
+    """bench_serve_ragged emits raw AND waste-adjusted problems/s for the
+    ragged and vmapped-XLA routes plus the workload's padding waste and
+    the speedup ratio — six lines, self-emitted like bench_serve_mixed."""
+    bench.bench_serve_ragged(problems=6, nrhs=2, reps=1, bucket=16)
+    by_metric = {ln["metric"]: ln for ln in _lines(capsys)}
+    assert set(by_metric) == {
+        "serve_ragged_padding_waste_pct",
+        "serve_ragged_ragged_problems_per_s",
+        "serve_ragged_xla_problems_per_s",
+        "serve_ragged_ragged_adjusted_problems_per_s",
+        "serve_ragged_xla_adjusted_problems_per_s",
+        "serve_ragged_speedup"}
+    waste = by_metric["serve_ragged_padding_waste_pct"]
+    assert waste["unit"] == "%" and 0.0 <= waste["value"] <= 100.0
+    for route in ("ragged", "xla"):
+        raw = by_metric[f"serve_ragged_{route}_problems_per_s"]
+        adj = by_metric[f"serve_ragged_{route}_adjusted_problems_per_s"]
+        assert raw["schema"] == "slate-bench-v1" and "chip" in raw
+        assert raw["unit"] == "problems/s" and raw["value"] > 0
+        assert adj["unit"] == "problems/s"
+        assert adj["value"] >= raw["value"]   # adjusted divides by 1-waste
+    assert by_metric["serve_ragged_speedup"]["unit"] == "x"
+    assert by_metric["serve_ragged_speedup"]["value"] > 0
+
+
 def test_step_lists_cover_every_metric(bench):
     """Both step lists must include the RBT speculation metric and stay
     callable (functions exist, kwargs are their signature's names)."""
@@ -92,6 +118,7 @@ def test_step_lists_cover_every_metric(bench):
         assert "bench_gesv_abft" in names
         assert "bench_posv_abft" in names
         assert "bench_serve_mixed" in names
+        assert "bench_serve_ragged" in names
         for fn, kwargs in steps:
             sig = inspect.signature(fn)
             assert set(kwargs) == set(sig.parameters)

@@ -23,6 +23,7 @@ The load-bearing guarantees:
 import json
 import warnings
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -302,6 +303,91 @@ def test_tuned_ladder_overrides_geometric(plan_cache):
     assert ev["bucket"][0] == 48           # tuned rung, not geometric 64
 
 
+# ------------------------------------------------------ ragged fast rungs
+
+
+def _record_ragged_plans(buckets=(32, 64)):
+    """Persist Pallas plans for the batch kernels at the given bucket
+    sizes, so `_ragged_plan` re-resolves them on every trace (including
+    the warm pass) without a live override context."""
+    for op in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+        for nb in buckets:
+            tune.record_plan(op, nb, "float32",
+                             tune.TilePlan("pallas", nb // 2, 8))
+
+
+def test_ragged_route_selected_only_through_plan_cache(plan_cache):
+    """SEAM011: make_batched routes the fast rung through the ragged
+    batched Pallas kernels IFF tune.resolve_plan hands back a Pallas
+    plan for the op's batch kernel at the bucket size — and the dtype /
+    Abft gates fall back to the vmapped cores."""
+    from slate_tpu.options import Abft, Option
+    from slate_tpu.serve.batched import make_batched
+    rng = _workload_rng()
+    a32 = jnp.asarray(rng.standard_normal((2, 32, 32)), jnp.float32)
+    b32 = jnp.asarray(rng.standard_normal((2, 32, 2)), jnp.float32)
+    sz = jnp.asarray([20, 32], jnp.int32)
+
+    def routes_ragged(op, a, b, opts=None):
+        with warnings.catch_warnings():
+            # repeated abstract traces of the same signature are the
+            # point of this test, not a serving regression
+            warnings.simplefilter("ignore", obs.SlateRetraceWarning)
+            jaxpr = jax.make_jaxpr(make_batched(op, opts))(a, b, sz)
+        return "pallas_call" in str(jaxpr)
+
+    assert not routes_ragged("solve", a32, b32)   # plan miss -> vmapped
+    _record_ragged_plans()
+    assert routes_ragged("solve", a32, b32)
+    assert routes_ragged("chol_solve", a32, b32)
+    # dtype gate: float64 stays on the vmapped route even with plans
+    assert not routes_ragged("solve", a32.astype(jnp.float64),
+                             b32.astype(jnp.float64))
+    # Abft gate: only batch_potrf carries the checksum rungs in-batch
+    abft = {Option.Abft: Abft.On}
+    assert not routes_ragged("solve", a32, b32, abft)
+    assert routes_ragged("chol_solve", a32, b32, abft)
+
+
+def test_warm_server_ragged_route_never_retraces(plan_cache):
+    """The acceptance drill for the ragged serving rung: with Pallas
+    plans persisted for the batch kernels, a float32 workload's fast
+    rung runs as the ragged batched kernels (`sizes` traced, one
+    executable per bucket), every result holds the certificate, and the
+    warm repeat is all cache hits — zero retrace-sentinel warnings,
+    zero new executables, compiled=False on every serve_batch event."""
+    _record_ragged_plans()
+    rng = _workload_rng()
+    reqs = []
+    for n in (20, 40):
+        reqs.append(("solve", *_mk_solve(rng, n, 3, np.float32)))
+        reqs.append(("chol_solve", *_mk_chol(rng, n, 3, np.float32)))
+        reqs.append(("least_squares_solve",
+                     *_mk_gels(rng, n, 2, np.float32)))
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as cold:
+        results = srv.serve_batch(reqs)
+    for req, res in zip(reqs, results):
+        _check(req, res)
+    cold_ev = _serve_events(cold)
+    assert cold_ev and all(e["compiled"] for e in cold_ev)
+    entries0 = srv.cache.stats()["entries"]
+    traces0 = sum(s["traces"] for s in obs.sentinel_stats().values())
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.SlateRetraceWarning)
+        with obs.recording() as warm:
+            results = srv.serve_batch(reqs)
+    warm_ev = _serve_events(warm)
+    assert len(warm_ev) == len(cold_ev)
+    assert not any(e["compiled"] for e in warm_ev)
+    assert all(e["retraces"] == 0 for e in warm_ev)
+    assert srv.cache.stats()["entries"] == entries0
+    assert sum(s["traces"] for s in obs.sentinel_stats().values()) == traces0
+    for req, res in zip(reqs, results):
+        _check(req, res)
+
+
 # ------------------------------------------------------- obs aggregation
 
 
@@ -332,8 +418,11 @@ def test_metrics_serving_table(tmp_path):
     assert row["esc_per_1k"] == 0.0
     assert row["compiles"] == 2            # cold round only
     assert row["retraces"] >= 0
+    # waste-adjusted problems/s: batches carry dur_ms, so the column is
+    # populated and exceeds the raw rate (waste > 0 at these sizes)
+    assert row["wa_pps"] is not None and row["wa_pps"] > 0
 
     from slate_tpu.obs import metrics
     text = metrics.render(summary)
     assert "serving" in text and "solve/float32" in text
-    assert "esc/1k" in text
+    assert "esc/1k" in text and "wa_pps" in text

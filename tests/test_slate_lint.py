@@ -807,6 +807,58 @@ def test_trc_fires_inside_partial_wrapped_kernel(tmp_path):
     assert lint(root2, {"TRC001"}) == []
 
 
+PALLAS_PREFETCH = """\
+    from functools import partial
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+
+    def _kernel(tiles_ref, a_ref, o_ref, *, bw):
+        if bw > 4:
+            o_ref[...] = a_ref[...]
+        if tiles_ref[0] > 0:
+            o_ref[...] = a_ref[...] * 2.0
+
+
+    def run(a, tiles, bw):
+        return pl.pallas_call(
+            partial(_kernel, bw=bw),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(4,),
+                in_specs=[pl.BlockSpec((8, 8), lambda i, tiles: (i, 0))],
+                out_specs=pl.BlockSpec((8, 8), lambda i, tiles: (i, 0))),
+            out_shape=jax.ShapeDtypeStruct(a.shape, a.dtype))(tiles, a)
+    """
+
+
+def test_prefetch_grid_spec_marks_scalar_refs_static(tmp_path):
+    """An inline PrefetchScalarGridSpec(num_scalar_prefetch=N) makes the
+    kernel's first N params scalar-prefetch refs: reachability records
+    them static alongside partial-bound keywords, so the ragged batched
+    kernels' size-vector reads do not fire trace rules."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": PALLAS_PREFETCH})
+    reach = reachability.compute(load_project(root))
+    info = reach.functions["slate_tpu/mod.py::_kernel"]
+    assert info.is_entry
+    assert {"tiles_ref", "bw"} <= info.static_params
+    assert lint(root, {"TRC001"}) == []
+
+    # bare-Name kernels (no partial) get the same treatment
+    bare = PALLAS_PREFETCH.replace("partial(_kernel, bw=bw),",
+                                   "_kernel,").replace(", *, bw", "")
+    bare = bare.replace("        if bw > 4:\n"
+                        "            o_ref[...] = a_ref[...]\n", "")
+    bare_dir = tmp_path / "bare"
+    bare_dir.mkdir()
+    root2 = mini_repo(bare_dir, {"slate_tpu/mod.py": bare})
+    reach2 = reachability.compute(load_project(root2))
+    assert "tiles_ref" in \
+        reach2.functions["slate_tpu/mod.py::_kernel"].static_params
+    assert lint(root2, {"TRC001"}) == []
+
+
 def test_seam011_fires_on_raw_plan_cache_outside_tune(tmp_path):
     """A driver touching the raw autotuner plan cache (instead of
     resolve_plan) fires SEAM011; the tune package itself is exempt."""

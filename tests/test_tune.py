@@ -184,3 +184,105 @@ def test_tune_op_persists_winner(cache):
     plan, gflops = autotune.tune_op("potrf_tile", 128, "float32", iters=1)
     assert gflops > 0
     assert resolve_plan("potrf_tile", 128) == plan
+
+
+def test_candidates_cover_batch_ops():
+    """The ragged serving kernels are tuned through the same candidate
+    sweep: XLA baseline plus legal pallas (nb | n), batch_geqrf without
+    a bw axis."""
+    from slate_tpu.tune import autotune
+    for op in ("batch_potrf", "batch_getrf"):
+        cands = list(autotune.candidates(op, 256, "float32"))
+        assert any(c.kernel == "xla" for c in cands)
+        pallas = [c for c in cands if c.kernel == "pallas"]
+        assert pallas and all(256 % c.nb == 0 for c in pallas)
+    qr = list(autotune.candidates("batch_geqrf", 256, "float32"))
+    assert any(c.kernel == "xla" for c in qr)
+    assert len({(c.kernel, c.nb) for c in qr}) == len(qr)
+
+
+@pytest.mark.slow
+def test_measure_batch_ops_both_routes(cache):
+    """Every batch-op candidate route actually runs and reports a
+    positive live-work rate (pallas in interpret mode on CPU)."""
+    from slate_tpu.tune import autotune
+    for op in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+        for plan in (XLA_PLAN, TilePlan("pallas", 64, 8)):
+            gf = autotune.measure(op, plan, 128, iters=1)
+            assert gf > 0, (op, plan)
+
+
+# ---- serve-bucket ladder fitting ----------------------------------------
+
+
+def test_serve_ladder_from_sizes_dp():
+    """The fitted ladder covers the max size, respects max_rungs, and
+    never wastes more padded area than the geometric ladder."""
+    from slate_tpu.tune import autotune
+    rng = np.random.default_rng(7)
+    sizes = ([int(x) for x in rng.integers(8, 120, 300)]
+             + [500] * 40 + [700] * 3)
+    ladder = autotune.serve_ladder_from_sizes(sizes, max_rungs=4)
+    assert len(ladder) <= 4
+    assert ladder == tuple(sorted(ladder))
+    assert ladder[-1] >= max(sizes)
+    assert all(r % 32 == 0 for r in ladder)
+    from slate_tpu.serve import bucket
+    tuned = autotune.ladder_waste(sizes, bucket.BucketLadder(ladder,
+                                                             "tuned"))
+    geo = autotune.ladder_waste(sizes, bucket.geometric_ladder())
+    assert 0.0 <= tuned <= geo < 1.0
+    # few distinct sizes: every edge becomes a rung, zero waste beyond
+    # the 32-multiple roundup
+    small = autotune.serve_ladder_from_sizes([64, 64, 128], max_rungs=8)
+    assert small == (64, 128)
+    with pytest.raises(ValueError):
+        autotune.serve_ladder_from_sizes([0, -3])
+
+
+def test_tune_serve_buckets_persists_and_serves(cache):
+    """tune_serve_buckets round trip: persisted rungs come back through
+    tune.serve_buckets and flip default_ladder to the tuned source."""
+    from slate_tpu.serve import bucket
+    from slate_tpu.tune import autotune
+    sizes = [24, 24, 40, 90, 90, 200]
+    rungs, w_geo, w_tuned = autotune.tune_serve_buckets(
+        sizes, dtype="float32", max_rungs=3)
+    assert len(rungs) <= 3 and rungs[-1] >= 200
+    assert w_tuned <= w_geo
+    assert tune.serve_buckets("float32") == rungs
+    lad = bucket.default_ladder("float32")
+    assert lad.source == "tuned" and lad.rungs == rungs
+
+
+def test_cli_serve_hist_fits_and_persists(cache, tmp_path, capsys):
+    """`python -m slate_tpu.tune --serve-hist` reads a request-size
+    JSONL (bare ints and {"n": ...} records), prints one line per rung
+    plus a summary, and persists unless --dry-run."""
+    from slate_tpu.tune.__main__ import main
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("\n".join(["17", '{"n": 48}', '{"size": 48}',
+                               "100", "100", "130"]) + "\n")
+    assert main(["--serve-hist", str(hist), "--hist-rungs", "3"]) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]
+    assert summary["op"] == tune.SERVE_BUCKET_OP
+    assert summary["persisted"] is True
+    assert summary["sizes"] == 6
+    assert tuple(summary["rungs"]) == tune.serve_buckets("float32")
+    assert (summary["padding_waste_tuned"]
+            <= summary["padding_waste_geometric"])
+    assert len(lines) == len(summary["rungs"]) + 1
+
+    tune.reload()
+    cache.unlink()
+    tune.reload()
+    assert main(["--serve-hist", str(hist), "--dry-run"]) == 0
+    assert json.loads(capsys.readouterr().out.strip().splitlines()
+                      [-1])["persisted"] is False
+    assert tune.serve_buckets("float32") is None
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"rows": 3}\n')
+    with pytest.raises(ValueError, match="n/size"):
+        main(["--serve-hist", str(bad)])

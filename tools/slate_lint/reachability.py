@@ -7,7 +7,11 @@ A function is traced when jax traces it rather than running it eagerly:
   ``jax.shard_map(f, ...)``, ``shard_map_unchecked(f, ...)`` (the compat
   shim in ``util/compat_jax.py``), ``pl.pallas_call(kernel, ...)`` or
   ``pl.pallas_call(partial(kernel, bw=bw), ...)`` (partial keywords are
-  static parameters of the kernel entry), or ``jax.vmap(f)`` — a vmapped
+  static parameters of the kernel entry; when the call carries an inline
+  ``grid_spec=pltpu.PrefetchScalarGridSpec(num_scalar_prefetch=N, ...)``
+  the kernel's first N parameters are the scalar-prefetch operand refs —
+  grid-shaping data the BlockSpec index maps consume, recorded static),
+  or ``jax.vmap(f)`` — a vmapped
   function runs under a batching trace, so everything it reaches is
   traced exactly as under jit (the serving layer's batched cores enter
   drivers this way);
@@ -281,23 +285,56 @@ class Reachability:
                     if wname in ENTRY_WRAPPERS and node.args:
                         self._wrapper_entry(node, None, rel, wname)
 
+    @staticmethod
+    def _prefetch_count(call: ast.Call) -> int:
+        """``num_scalar_prefetch`` of a pallas_call's INLINE
+        ``grid_spec=PrefetchScalarGridSpec(...)``; 0 when absent or not a
+        literal.  The spec must be constructed inside the call for the
+        count to be visible — the repo's kernel style."""
+        for kw in call.keywords:
+            if kw.arg != "grid_spec" or not isinstance(kw.value, ast.Call):
+                continue
+            if (Reachability._callable_name(kw.value.func)
+                    != "PrefetchScalarGridSpec"):
+                continue
+            for skw in kw.value.keywords:
+                if (skw.arg == "num_scalar_prefetch"
+                        and isinstance(skw.value, ast.Constant)
+                        and isinstance(skw.value.value, int)):
+                    return skw.value.value
+        return 0
+
+    def _prefetch_params(self, key: str | None, count: int) -> set[str]:
+        """The kernel's first ``count`` parameter names: the scalar-
+        prefetch operand refs, which carry grid-shaping scalars (consumed
+        by BlockSpec index maps), not traced tile data."""
+        if key is None or count <= 0:
+            return set()
+        names = [a.arg for a in self.functions[key].params()]
+        return set(names[:count])
+
     def _wrapper_entry(self, call: ast.Call, scope: FuncInfo | None,
                        rel: str, wname: str):
         static = (self._static_argnames(call.keywords)
                   if wname in JIT_LIKE else set())
+        prefetch = (self._prefetch_count(call) if wname == "pallas_call"
+                    else 0)
         target = call.args[0]
         if isinstance(target, ast.Name):
-            self._mark_entry(self.resolve_name(target.id, scope, rel),
-                             static)
+            key = self.resolve_name(target.id, scope, rel)
+            self._mark_entry(key,
+                             static | self._prefetch_params(key, prefetch))
         elif (isinstance(target, ast.Call)
               and self._callable_name(target.func) == "partial"
               and target.args and isinstance(target.args[0], ast.Name)):
             # pallas_call(partial(_kernel, bw=bw), ...): the kernel is the
             # traced entry; partial's keyword bindings are closure values
             # fixed at trace time, hence static parameters of the kernel.
+            key = self.resolve_name(target.args[0].id, scope, rel)
             self._mark_entry(
-                self.resolve_name(target.args[0].id, scope, rel),
-                {kw.arg for kw in target.keywords if kw.arg is not None})
+                key,
+                {kw.arg for kw in target.keywords if kw.arg is not None}
+                | self._prefetch_params(key, prefetch))
         elif isinstance(target, ast.Lambda):
             # the lambda body is traced: its resolvable callees are roots.
             # Only arguments fed from the LAMBDA'S OWN parameters are
