@@ -12,14 +12,19 @@ building block of the 2D-grid configs) plus raw-MXU context:
   geqrf  131072x1024  (config #4: tall-skinny Householder QR)
   gels   131072x1024  (config #4: least squares, auto method = CholQR)
 
-Each line reports GFLOP/s/chip and ``mfu`` — the fraction of the chip's
-dense-matmul peak (see _chip_peak; on TPU the MXU computes bf16 x bf16 ->
-f32, and XLA's default f32 matmul runs single-pass at that same rate, so one
-peak number applies to both precisions).  FLOP formulas follow the reference
-tester: gemm 2mnk (ref: src/gemm.cc:24), potrf n^3/3 + solve 2n^2*nrhs
-(ref: src/potrf.cc:334), getrf 2n^3/3 + solve, geqrf 2mn^2 - 2n^3/3
-(testsweeper gflop helpers); gels reports the same nominal flops as the QR
-path regardless of method, as the reference tester does.
+Each line reports GFLOP/s/chip, ``mfu`` — the fraction of the chip's
+dense-matmul peak — ``device_ms`` (best-rep seconds per chained solve) and
+``flops`` (the per-iteration analytic count).  Both the flop formulas and
+the chip-peak table come from slate_tpu.obs.flops — the SAME registry that
+prices driver events under ``obs.timing()`` — so a bench line and a
+production event can never disagree about an op's MFU (on TPU the MXU
+computes bf16 x bf16 -> f32, and XLA's default f32 matmul runs single-pass
+at that same rate, so one peak number applies to both precisions).  The
+registered counts follow the reference tester: gemm 2mnk (ref:
+src/gemm.cc:24), potrf n^3/3 + solve 2n^2*nrhs (ref: src/potrf.cc:334),
+getrf 2n^3/3 + solve, geqrf 2mn^2 - 2n^3/3 (testsweeper gflop helpers);
+gels reports the same nominal flops as the QR path regardless of method,
+as the reference tester does.
 
 Timing: the remote-tunnel platform makes block_until_ready a no-op and a
 host fetch costs ~70 ms round trip, so each benchmark chains ``iters``
@@ -51,6 +56,7 @@ from jax import lax
 
 import slate_tpu as st
 from slate_tpu.core.storage import TileStorage
+from slate_tpu.obs import flops as _flops
 from slate_tpu.obs.metrics import BENCH_SCHEMA
 
 BASELINE_GFLOPS_PER_CHIP = 702.0  # ref docs/usage.md:41-42, per-GPU dgemm
@@ -65,17 +71,9 @@ BUDGET_S = float(os.environ.get("SLATE_BENCH_BUDGET_S", "0") or 0)
 
 def _chip_peak():
     """(dense matmul peak FLOP/s, device_kind) for MFU; None if unknown.
-
-    Public spec-sheet bf16 MXU peaks per chip generation.  XLA's default
-    (single-pass) f32 matmul runs at the same MXU rate.
-    """
-    kind = jax.devices()[0].device_kind.lower()
-    table = [("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12),
-             ("v5e", 197e12), ("v4", 275e12), ("v3", 123e12), ("v2", 46e12)]
-    for key, peak in table:
-        if key in kind:
-            return peak, kind
-    return None, kind
+    Delegates to obs.flops.chip_peak — ONE peak table for bench lines and
+    timed driver events alike."""
+    return _flops.chip_peak()
 
 
 PEAK, CHIP = None, "cpu"
@@ -92,7 +90,8 @@ def _mat(dense, mb, nb):
 
 
 def _time_chain(body, init, args, iters, flops_per_iter, reps=3):
-    """Best-of-reps GFLOP/s for ``iters`` dependent body applications.
+    """Best-of-reps (GFLOP/s, seconds-per-iteration) for ``iters``
+    dependent body applications.
 
     ``args`` (the big operands) are jit ARGUMENTS, not closure constants —
     the remote-compile tunnel serializes closed-over arrays into the compile
@@ -117,10 +116,17 @@ def _time_chain(body, init, args, iters, flops_per_iter, reps=3):
         t0 = time.perf_counter()
         np.asarray(jax.device_get(run(init, *args)))
         times.append(time.perf_counter() - t0)
-    return flops_per_iter * iters / min(times) / 1e9
+    sec = min(times) / iters
+    return flops_per_iter / sec / 1e9, sec
 
 
-def _emit(metric, gflops, extra=None):
+def _emit(metric, timed, flops=None, extra=None):
+    """One bench line.  ``timed`` is a _time_chain result — (GFLOP/s,
+    sec-per-iter) — or a bare GFLOP/s number; ``flops`` the analytic
+    per-iteration count (from the obs.flops registry) recorded so any
+    consumer can re-derive mfu = value*1e9 / PEAK without re-implementing
+    the model."""
+    gflops, sec = timed if isinstance(timed, tuple) else (timed, None)
     line = {
         "schema": BENCH_SCHEMA,
         "metric": metric,
@@ -129,6 +135,8 @@ def _emit(metric, gflops, extra=None):
         "vs_baseline": round(float(gflops) / BASELINE_GFLOPS_PER_CHIP, 2),
         "mfu": (round(gflops * 1e9 / PEAK, 3) if PEAK else None),
         "chip": CHIP,
+        "device_ms": (round(sec * 1e3, 3) if sec is not None else None),
+        "flops": flops,
     }
     if extra:
         line.update(extra)
@@ -150,9 +158,10 @@ def bench_gemm(n, nb, iters):
                                   B.storage.grid)))
         return C.storage.data
 
-    gflops = _time_chain(body, B.storage.data, (A.storage.data,), iters,
-                         2.0 * n * n * n)
-    _emit(f"gemm_n{n}_gflops_per_chip", gflops, {"nb": nb})
+    flops = _flops.op_flops("gemm", [(n, n), (n, n)])
+    timed = _time_chain(body, B.storage.data, (A.storage.data,), iters,
+                        flops)
+    _emit(f"gemm_n{n}_gflops_per_chip", timed, flops, {"nb": nb})
 
 
 def bench_posv(n, nb, nrhs, iters):
@@ -168,9 +177,10 @@ def bench_posv(n, nb, nrhs, iters):
         _, X = st.posv(H, _mat(b, nb, nb))
         return X.to_dense()[0, 0] * 1e-24      # data dependence, ~0
 
-    flops = n**3 / 3.0 + 2.0 * n * n * nrhs
-    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"posv_n{n}_gflops_per_chip", gflops, {"nb": nb, "nrhs": nrhs})
+    flops = _flops.op_flops("posv", [(n, n), (n, nrhs)])
+    timed = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"posv_n{n}_gflops_per_chip", timed, flops,
+          {"nb": nb, "nrhs": nrhs})
 
 
 def bench_gesv(n, nb, nrhs, iters):
@@ -189,9 +199,9 @@ def bench_gesv(n, nb, nrhs, iters):
         _, X = st.gesv(A, _mat(b, nb, nb), opts)
         return X.to_dense()[0, 0] * 1e-24
 
-    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
-    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"gesv_n{n}_gflops_per_chip", gflops,
+    flops = _flops.op_flops("gesv", [(n, n), (n, nrhs)])
+    timed = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gesv_n{n}_gflops_per_chip", timed, flops,
           {"nb": nb, "nrhs": nrhs, "method": "tntpiv"})
 
 
@@ -203,9 +213,9 @@ def bench_geqrf(m, n, nb, iters):
         F = st.geqrf(_mat(a * (1.0 + carry), nb, nb))
         return F.QR.to_dense()[0, 0] * 1e-24
 
-    flops = 2.0 * m * n * n - 2.0 * n**3 / 3.0
-    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
-    _emit(f"geqrf_tall_{m}x{n}_gflops_per_chip", gflops, {"nb": nb})
+    flops = _flops.op_flops("geqrf", [(m, n)])
+    timed = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"geqrf_tall_{m}x{n}_gflops_per_chip", timed, flops, {"nb": nb})
 
 
 def bench_gels(m, n, nb, nrhs, iters):
@@ -218,9 +228,9 @@ def bench_gels(m, n, nb, nrhs, iters):
         return X.to_dense()[0, 0] * 1e-24
 
     # nominal QR-path flops, as the reference tester reports for any method
-    flops = 2.0 * m * n * n - 2.0 * n**3 / 3.0 + 4.0 * m * n * nrhs
-    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"gels_tall_{m}x{n}_gflops_per_chip", gflops,
+    flops = _flops.op_flops("gels", [(m, n), (m, nrhs)])
+    timed = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gels_tall_{m}x{n}_gflops_per_chip", timed, flops,
           {"nb": nb, "nrhs": nrhs, "method": "cholqr"})
 
 
@@ -242,9 +252,9 @@ def bench_gesv_rbt(n, nb, nrhs, iters):
         _, X, h = st.gesv(A, _mat(b, nb, nb), opts)
         return X.to_dense()[0, 0] * 1e-24
 
-    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
-    gflops = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"gesv_rbt_n{n}_gflops_per_chip", gflops,
+    flops = _flops.op_flops("gesv", [(n, n), (n, nrhs)])
+    timed = _time_chain(body, jnp.float32(0.0), (a, b), iters, flops)
+    _emit(f"gesv_rbt_n{n}_gflops_per_chip", timed, flops,
           {"nb": nb, "nrhs": nrhs, "method": "rbt+nopiv"})
 
 
@@ -265,15 +275,15 @@ def bench_gesv_abft(n, nb, nrhs, iters):
             return out[1].to_dense()[0, 0] * 1e-24
         return body
 
-    flops = 2.0 * n**3 / 3.0 + 2.0 * n * n * nrhs
-    plain = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
-                        flops)
+    flops = _flops.op_flops("gesv", [(n, n), (n, nrhs)])
+    plain, _ = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
+                           flops)
     prot = _time_chain(
         body_for({st.Option.Abft: "on", st.Option.ErrorPolicy: "info"}),
         jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"gesv_abft_n{n}_gflops_per_chip", prot,
+    _emit(f"gesv_abft_n{n}_gflops_per_chip", prot, flops,
           {"nb": nb, "nrhs": nrhs, "plain_gflops": round(float(plain), 1),
-           "abft_overhead_pct": round((plain / prot - 1.0) * 100.0, 1)})
+           "abft_overhead_pct": round((plain / prot[0] - 1.0) * 100.0, 1)})
 
 
 def bench_posv_abft(n, nb, nrhs, iters):
@@ -291,15 +301,15 @@ def bench_posv_abft(n, nb, nrhs, iters):
             return out[1].to_dense()[0, 0] * 1e-24
         return body
 
-    flops = n**3 / 3.0 + 2.0 * n * n * nrhs
-    plain = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
-                        flops)
+    flops = _flops.op_flops("posv", [(n, n), (n, nrhs)])
+    plain, _ = _time_chain(body_for(None), jnp.float32(0.0), (a, b), iters,
+                           flops)
     prot = _time_chain(
         body_for({st.Option.Abft: "on", st.Option.ErrorPolicy: "info"}),
         jnp.float32(0.0), (a, b), iters, flops)
-    _emit(f"posv_abft_n{n}_gflops_per_chip", prot,
+    _emit(f"posv_abft_n{n}_gflops_per_chip", prot, flops,
           {"nb": nb, "nrhs": nrhs, "plain_gflops": round(float(plain), 1),
-           "abft_overhead_pct": round((plain / prot - 1.0) * 100.0, 1)})
+           "abft_overhead_pct": round((plain / prot[0] - 1.0) * 100.0, 1)})
 
 
 def bench_heev(n, nb, iters):
@@ -320,9 +330,9 @@ def bench_heev(n, nb, iters):
         w = st.heev_vals(H)
         return w[0] * 1e-24
 
-    flops = 4.0 * n**3 / 3.0           # ref heev gflop count (reduction)
-    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
-    _emit(f"heev_vals_n{n}_gflops_per_chip", gflops, {"nb": nb})
+    flops = _flops.op_flops("heev_vals", [(n, n)])
+    timed = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"heev_vals_n{n}_gflops_per_chip", timed, flops, {"nb": nb})
 
 
 def bench_svd(n, nb, iters):
@@ -335,9 +345,9 @@ def bench_svd(n, nb, iters):
         s = st.svd_vals(_mat(a * (1.0 + carry), nb, nb))
         return s[0] * 1e-24
 
-    flops = 8.0 * n**3 / 3.0               # ref gesvd reduction count
-    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
-    _emit(f"svd_vals_n{n}_gflops_per_chip", gflops, {"nb": nb})
+    flops = _flops.op_flops("svd_vals", [(n, n)])
+    timed = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"svd_vals_n{n}_gflops_per_chip", timed, flops, {"nb": nb})
 
 
 def _kernel_interpret():
@@ -371,11 +381,13 @@ def bench_potrf_fused(n, nb, bw, iters):
                                     bw=bw, interpret=interp)
         return fac[0, 0] * 1e-24
 
-    # update 2*n*nb*k + tile factor nb^3/3 + panel solve (n-nb)*nb^2
+    # update 2*n*nb*k + tile factor nb^3/3 + panel solve (n-nb)*nb^2 —
+    # a kernel-seam cost, not a public op, so no registry entry applies
     flops = 2.0 * n * nb * k + nb**3 / 3.0 + (n - nb) * nb**2
-    gflops = _time_chain(body, jnp.float32(0.0), (col, left, lead),
-                         iters, flops)
-    _emit(f"potrf_fused_n{n}_gflops_per_chip", gflops, {"nb": nb, "bw": bw})
+    timed = _time_chain(body, jnp.float32(0.0), (col, left, lead),
+                        iters, flops)
+    _emit(f"potrf_fused_n{n}_gflops_per_chip", timed, flops,
+          {"nb": nb, "bw": bw})
 
 
 def bench_geqrf_panel(m, n, iters):
@@ -393,8 +405,8 @@ def bench_geqrf_panel(m, n, iters):
         return packed[0, 0] * 1e-24
 
     flops = 2.0 * m * n**2            # dominant term of 2mn^2 - 2n^3/3
-    gflops = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
-    _emit(f"geqrf_panel_m{m}_n{n}_gflops_per_chip", gflops)
+    timed = _time_chain(body, jnp.float32(0.0), (a,), iters, flops)
+    _emit(f"geqrf_panel_m{m}_n{n}_gflops_per_chip", timed, flops)
 
 
 def bench_serve_mixed(problems, nrhs, reps, sizes):

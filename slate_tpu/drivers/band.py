@@ -103,7 +103,7 @@ def _wrap_like(x, Bm, n):
 
 # ------------------------------------------------------------- pb chain
 
-@annotate("slate.pbtrf")
+@annotate("slate.pbtrf")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def pbtrf(A: HermitianBandMatrix, opts: Options | None = None) -> PBFactors:
     """Band Cholesky A = L L^H (ref: src/pbtrf.cc)."""
     slate_error(isinstance(A, HermitianBandMatrix),
@@ -125,7 +125,7 @@ def pbtrf(A: HermitianBandMatrix, opts: Options | None = None) -> PBFactors:
             info=int(hh.info)))
 
 
-@annotate("slate.pbtrs")
+@annotate("slate.pbtrs")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def pbtrs(F: PBFactors, B, opts: Options | None = None):
     """Solve from pbtrf factors (ref: src/pbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
@@ -133,7 +133,7 @@ def pbtrs(F: PBFactors, B, opts: Options | None = None):
     return _wrap_like(x, Bm, F.n)
 
 
-@annotate("slate.pbsv")
+@annotate("slate.pbsv")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def pbsv(A: HermitianBandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A Hermitian positive-definite band (ref: src/pbsv.cc).
     Returns (PBFactors, X); ``(F, X, HealthInfo)`` under ErrorPolicy.Info."""
@@ -149,7 +149,7 @@ def pbsv(A: HermitianBandMatrix, B, opts: Options | None = None):
 
 # ------------------------------------------------------------- gb chain
 
-@annotate("slate.gbtrf")
+@annotate("slate.gbtrf")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def gbtrf(A: BandMatrix, opts: Options | None = None) -> GBFactors:
     """Band LU with partial pivoting (ref: src/gbtrf.cc).  Pivoting is
     bounded within kl rows below the diagonal, so the factorization runs as
@@ -182,7 +182,7 @@ def gbtrf(A: BandMatrix, opts: Options | None = None) -> GBFactors:
             f"({hh.describe()})", info=int(hh.info)))
 
 
-@annotate("slate.gbtrs")
+@annotate("slate.gbtrs")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def gbtrs(F: GBFactors, B, opts: Options | None = None):
     """Solve from gbtrf factors (ref: src/gbtrs.cc)."""
     b, Bm = _as_dense_rhs(B)
@@ -190,7 +190,7 @@ def gbtrs(F: GBFactors, B, opts: Options | None = None):
     return _wrap_like(x, Bm, F.n)
 
 
-@annotate("slate.gbsv")
+@annotate("slate.gbsv")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def gbsv(A: BandMatrix, B, opts: Options | None = None):
     """Solve A X = B, A general band (ref: src/gbsv.cc).
     Returns (GBFactors, X); ``(F, X, HealthInfo)`` under ErrorPolicy.Info."""
@@ -224,7 +224,7 @@ def _finalize_band_solve(name, F, X, h, opts, make_exc):
 
 # ------------------------------------------------------------- tbsm
 
-@annotate("slate.tbsm")
+@annotate("slate.tbsm")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def tbsm(side, alpha, A: TriangularBandMatrix, B,
          opts: Options | None = None):
     """Triangular band solve op(A) X = alpha B (Left) or X op(A) = alpha B
@@ -289,7 +289,7 @@ def _tbsm_left(A: TriangularBandMatrix, alpha, b, extra_op: Op):
 
 # ------------------------------------------------------------- band multiply
 
-@annotate("slate.gbmm")
+@annotate("slate.gbmm")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def gbmm(alpha, A: BandMatrix, B, beta=0.0, C=None,
          opts: Options | None = None):
     """C = alpha op(A) B + beta C with A band (ref: src/gbmm.cc)."""
@@ -307,7 +307,7 @@ def gbmm(alpha, A: BandMatrix, B, beta=0.0, C=None,
     return _wrap_like(out, Bm if Bm is not None else C, m)
 
 
-@annotate("slate.hbmm")
+@annotate("slate.hbmm")  # slate-lint: disable=OBS002 -- band cost needs kl/ku, not recoverable from event shapes
 def hbmm(side, alpha, A: HermitianBandMatrix, B, beta=0.0, C=None,
          opts: Options | None = None):
     """C = alpha A B + beta C with A Hermitian band (ref: src/hbmm.cc).

@@ -10,17 +10,25 @@ Four pieces, all host-side and zero-overhead when disabled:
 - a retrace sentinel (:mod:`sentinel`): per-signature trace counters
   with rate-limited warnings on retrace/recompile churn;
 - metrics aggregation (:mod:`metrics`) behind the
-  ``python -m slate_tpu.obs`` CLI.
+  ``python -m slate_tpu.obs`` CLI;
+- device-time truth (:mod:`flops`): one analytic flop/byte model per
+  public op feeding ``device_ms``/``mfu``/``achieved_gbps`` on events
+  under the opt-in :func:`timing` mode — bench.py prices its lines
+  from the SAME registry;
+- serving SLOs (:mod:`slo`) over the flight-recorder fields, and a
+  bench-round regression sentinel (:mod:`compare`) behind
+  ``--slo`` / ``--compare``.
 
 The jaxpr-identity guarantee: enabling any of this changes NOTHING in
 traced computations (no io_callback, no extra ops) — recording reads
 returned HealthInfo and host clocks only.
 """
 
+from . import compare, flops, slo
 from .events import (SCHEMA, boundary_enter, boundary_exit, clear,
                      configure, disable, enable, enabled, emit_serve_batch,
                      note_health, note_path, note_plan, note_resolved,
-                     recent, recording)
+                     recent, recording, set_timing, timing, timing_enabled)
 from .metrics import render, summarize
 from .sentinel import SlateRetraceWarning
 from .sentinel import reset as reset_sentinel
@@ -29,8 +37,9 @@ from .tracer import SpanRecorder, record_spans
 
 __all__ = [
     "SCHEMA", "SlateRetraceWarning", "SpanRecorder", "boundary_enter",
-    "boundary_exit", "clear", "configure", "disable", "enable", "enabled",
-    "emit_serve_batch", "note_health", "note_path", "note_plan",
-    "note_resolved", "recent", "record_spans", "recording", "render",
-    "reset_sentinel", "sentinel_stats", "summarize",
+    "boundary_exit", "clear", "compare", "configure", "disable", "enable",
+    "enabled", "emit_serve_batch", "flops", "note_health", "note_path",
+    "note_plan", "note_resolved", "recent", "record_spans", "recording",
+    "render", "reset_sentinel", "sentinel_stats", "set_timing", "slo",
+    "summarize", "timing", "timing_enabled",
 ]

@@ -41,6 +41,7 @@ from collections import deque
 
 import jax
 
+from . import flops as _flops
 from . import sentinel as _sentinel
 
 SCHEMA = "slate-obs-v1"
@@ -48,7 +49,7 @@ _MAX_PLANS_PER_EVENT = 8          # bound event size for tile-heavy drivers
 
 _TLS = threading.local()
 _LOCK = threading.Lock()
-_CFG = {"enabled": False, "path": None}
+_CFG = {"enabled": False, "path": None, "timing": False}
 _RING: deque = deque(maxlen=int(os.environ.get("SLATE_OBS_RING", "256")))
 _COLLECTORS: list[list] = []
 
@@ -57,7 +58,7 @@ class _Frame:
     """One open driver boundary (host-side bookkeeping only)."""
 
     __slots__ = ("op", "t0", "traced", "shapes", "dtype", "notes",
-                 "plans_seen")
+                 "plans_seen", "device_ms")
 
     def __init__(self, op, traced, shapes, dtype):
         self.op = op
@@ -67,6 +68,7 @@ class _Frame:
         self.dtype = dtype
         self.notes: dict = {}
         self.plans_seen: set = set()
+        self.device_ms: float | None = None
 
 
 def _frames() -> list:
@@ -139,6 +141,57 @@ def recent(n: int | None = None) -> list:
 def clear() -> None:
     with _LOCK:
         _RING.clear()
+
+
+# ------------------------------------------------------------------ timing
+#
+# Device timing is OPT-IN: when on, the outermost eager driver boundary
+# blocks until its result is device-ready and the event's ``device_ms``
+# measures dispatch->ready instead of just host wall time.  The sync
+# happens strictly OUTSIDE traced code (the annotate wrapper consults
+# :func:`should_time`, which refuses traced frames), so the
+# jaxpr-identity guarantee is untouched: timing on or off, the traced
+# computation is byte-identical — only the host waits differently.
+
+
+def timing_enabled() -> bool:
+    """Is device-time measurement on (``obs.timing()`` or
+    ``SLATE_OBS_TIMING=1``)?"""
+    return _CFG["timing"]
+
+
+def set_timing(on: bool) -> None:
+    with _LOCK:
+        _CFG["timing"] = bool(on)
+
+
+@contextlib.contextmanager
+def timing(on: bool = True):
+    """Scope device-time measurement: events gain ``device_ms`` /
+    ``mfu`` / ``achieved_gbps`` (None outside the scope)."""
+    prev = _CFG["timing"]
+    set_timing(on)
+    try:
+        yield
+    finally:
+        set_timing(prev)
+
+
+def should_time(token) -> bool:
+    """Should the annotate wrapper block_until_ready for this boundary?
+    Only the OUTERMOST eager frame with timing on — nested boundaries
+    would double-sync, and traced frames hold tracers, not buffers."""
+    if token is None or not _CFG["timing"] or token.traced:
+        return False
+    frames = _frames()
+    return bool(frames) and frames[0] is token
+
+
+def note_device_ready(token) -> None:
+    """Stamp the boundary's dispatch->device-ready time (called by the
+    annotate wrapper right after ``jax.block_until_ready(out)``)."""
+    if token is not None:
+        token.device_ms = round((time.perf_counter() - token.t0) * 1e3, 3)
 
 
 # ---------------------------------------------------------------- describe
@@ -230,15 +283,25 @@ def _outer() -> _Frame | None:
 
 def _build(frame: _Frame, error) -> dict:
     notes = frame.notes
+    op = frame.op[6:] if frame.op.startswith("slate.") else frame.op
+    mfu = gbps = None
+    if frame.device_ms:
+        secs = frame.device_ms * 1e-3
+        mfu = _flops.mfu(_flops.op_flops(op, frame.shapes), secs)
+        gbps = _flops.achieved_gbps(
+            _flops.op_bytes(op, frame.shapes, frame.dtype), secs)
     return {
         "schema": SCHEMA,
         "kind": "event",
         "ts": time.time(),
-        "op": frame.op[6:] if frame.op.startswith("slate.") else frame.op,
+        "op": op,
         "shapes": frame.shapes,
         "dtype": frame.dtype,
         "traced": frame.traced,
         "dur_ms": round((time.perf_counter() - frame.t0) * 1e3, 3),
+        "device_ms": frame.device_ms,
+        "mfu": mfu,
+        "achieved_gbps": gbps,
         "policy": notes.get("policy"),
         "speculate": notes.get("speculate"),
         "abft": notes.get("abft"),
@@ -360,6 +423,9 @@ def _init_from_env() -> None:
     path = os.environ.get("SLATE_OBS_EVENTS")
     if path:
         configure(enabled=True, path=path)
+    if os.environ.get("SLATE_OBS_TIMING", "").lower() in ("1", "true",
+                                                          "on", "yes"):
+        set_timing(True)
 
 
 _init_from_env()

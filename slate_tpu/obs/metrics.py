@@ -26,29 +26,74 @@ BENCH_SCHEMA = "slate-bench-v1"
 def load_lines(paths) -> list[dict]:
     """Parse JSONL files (or whole-file JSON arrays); non-JSON lines and
     non-dict records are skipped, not fatal — logs interleave."""
+    return load_records(paths)[0]
+
+
+def load_records(paths) -> tuple[list[dict], int]:
+    """Like :func:`load_lines` but also counts MALFORMED lines — lines
+    that look like truncated/garbled JSON records (start with ``{`` but
+    fail to parse, exactly what a watchdog-killed run leaves behind).
+    Ordinary interleaved log lines stay silently skipped.
+
+    Also accepts the historical ``BENCH_r*.json`` wrapper format: a
+    single pretty-printed JSON object whose ``tail`` string holds the
+    run's log+JSONL mixed output — the metric lines inside ``tail`` are
+    extracted as records."""
     out: list[dict] = []
+    malformed = 0
     for path in paths:
         with open(path, encoding="utf-8") as fh:
             text = fh.read()
         stripped = text.lstrip()
-        if stripped.startswith("["):
+        whole = None
+        if stripped.startswith(("[", "{")):
             try:
-                arr = json.loads(stripped)
+                whole = json.loads(stripped)
             except ValueError:
-                arr = []
-            out.extend(x for x in arr if isinstance(x, dict))
+                whole = None
+        if isinstance(whole, list):
+            for x in whole:
+                if isinstance(x, dict):
+                    out.append(x)
+                else:
+                    malformed += 1
             continue
-        for line in text.splitlines():
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(obj, dict):
-                out.append(obj)
-    return out
+        if isinstance(whole, dict):
+            if isinstance(whole.get("tail"), str):
+                # pre-schema bench-round wrapper: harvest the tail
+                n, m = _parse_lines(whole["tail"], out)
+                malformed += m
+                if n == 0 and m == 0:
+                    out.append(whole)      # no records inside: keep wrapper
+            else:
+                out.append(whole)          # single-record file
+            continue
+        malformed += _parse_lines(text, out)[1]
+    return out, malformed
+
+
+def _parse_lines(text: str, out: list) -> tuple[int, int]:
+    """Append each parseable JSON-dict line of ``text`` to ``out``;
+    returns (records appended, malformed lines).  A line counts as
+    malformed only when it *starts* like a JSON record (``{``) and fails
+    — plain log lines are not data and are skipped silently."""
+    added = malformed = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            if line.startswith("{"):
+                malformed += 1
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+            added += 1
+        elif line.startswith(("{", "[")):
+            malformed += 1
+    return added, malformed
 
 
 def split_records(records):
@@ -91,7 +136,8 @@ def summarize_events(events) -> dict:
         s = ops.setdefault(op, {
             "count": 0, "traced": 0, "errors": 0, "escalated": 0,
             "speculated": 0, "abft_detected": 0, "abft_corrected": 0,
-            "cert_fail": 0, "unhealthy": 0, "_durs": []})
+            "cert_fail": 0, "unhealthy": 0, "_durs": [], "_dev": [],
+            "_mfu": []})
         s["count"] += 1
         if e.get("traced"):
             s["traced"] += 1
@@ -99,6 +145,10 @@ def summarize_events(events) -> dict:
             d = e.get("dur_ms")
             if isinstance(d, (int, float)):
                 s["_durs"].append(float(d))
+        if isinstance(e.get("device_ms"), (int, float)):
+            s["_dev"].append(float(e["device_ms"]))
+        if isinstance(e.get("mfu"), (int, float)):
+            s["_mfu"].append(float(e["mfu"]))
         status = e.get("status") or "ok"
         if status != "ok":
             s["errors"] += 1
@@ -117,9 +167,12 @@ def summarize_events(events) -> dict:
                 s["unhealthy"] += 1
     for s in ops.values():
         durs = s.pop("_durs")
+        dev, mfus = s.pop("_dev"), s.pop("_mfu")
         n = max(s["count"], 1)
         s["p50_ms"] = percentile(durs, 50)
         s["p99_ms"] = percentile(durs, 99)
+        s["device_p50_ms"] = percentile(dev, 50)
+        s["mfu"] = round(sum(mfus) / len(mfus), 4) if mfus else None
         s["escalation_rate"] = round(s["escalated"] / n, 4)
         s["cert_fail_rate"] = round(s["cert_fail"] / n, 4)
         s["error_rate"] = round(s["errors"] / n, 4)
@@ -172,7 +225,8 @@ def summarize_serve(serve) -> dict:
         key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
         s = table.setdefault(key, {
             "batches": 0, "problems": 0, "escalated": 0, "compiles": 0,
-            "retraces": 0, "_occ": [], "_waste": [], "_dur_ms": 0.0})
+            "retraces": 0, "_occ": [], "_waste": [], "_dur_ms": 0.0,
+            "_lat": [], "_age": [], "_mfu": []})
         s["batches"] += 1
         s["problems"] += int(e.get("problems") or 0)
         s["escalated"] += int(e.get("escalated") or 0)
@@ -184,12 +238,26 @@ def summarize_serve(serve) -> dict:
             s["_waste"].append(float(e["padding_waste"]))
         if isinstance(e.get("dur_ms"), (int, float)):
             s["_dur_ms"] += float(e["dur_ms"])
+        # flight-recorder fields: per-problem lists per batch
+        for field, acc in (("latency_ms", "_lat"),
+                           ("age_at_flush_ms", "_age")):
+            vals = e.get(field)
+            if isinstance(vals, list):
+                s[acc].extend(float(v) for v in vals
+                              if isinstance(v, (int, float)))
+        if isinstance(e.get("mfu"), (int, float)):
+            s["_mfu"].append(float(e["mfu"]))
     for s in table.values():
         occ, waste = s.pop("_occ"), s.pop("_waste")
+        lat, age, mfus = s.pop("_lat"), s.pop("_age"), s.pop("_mfu")
         dur_s = s.pop("_dur_ms") / 1e3
         s["occupancy_p50"] = percentile(occ, 50)
         s["occupancy_p99"] = percentile(occ, 99)
         s["padding_waste_p50"] = percentile(waste, 50)
+        s["latency_p50_ms"] = percentile(lat, 50)
+        s["latency_p99_ms"] = percentile(lat, 99)
+        s["age_p99_ms"] = percentile(age, 99)
+        s["mfu"] = round(sum(mfus) / len(mfus), 4) if mfus else None
         probs = max(s["problems"], 1)
         s["esc_per_1k"] = round(1000.0 * s["escalated"] / probs, 2)
         w = s["padding_waste_p50"] or 0.0
@@ -200,13 +268,13 @@ def summarize_serve(serve) -> dict:
 
 def summarize(paths) -> dict:
     """Everything the CLI prints, as one JSON-able dict."""
-    records = load_lines(paths)
+    records, malformed = load_records(paths)
     events, spans, serve, bench, unknown = split_records(records)
     return {
         "files": [str(p) for p in paths],
         "counts": {"events": len(events), "spans": len(spans),
                    "serve": len(serve), "bench": len(bench),
-                   "unknown": len(unknown)},
+                   "unknown": len(unknown), "malformed": malformed},
         "ops": summarize_events(events),
         "plans": summarize_plans(events),
         "serve": summarize_serve(serve),
@@ -245,25 +313,29 @@ def render(summary: dict) -> str:
                  + (f", {c['unknown']} unknown" if c["unknown"] else ""))
     if summary["ops"]:
         rows = [[op, s["count"], s["traced"], s["p50_ms"], s["p99_ms"],
+                 s.get("device_p50_ms"), s.get("mfu"),
                  s["escalation_rate"], s["cert_fail_rate"],
                  f"{s['abft_corrected']}/{s['abft_detected']}",
                  s["error_rate"]]
                 for op, s in sorted(summary["ops"].items())]
         parts.append("\nper-op events\n" + _table(
-            ["op", "calls", "traced", "p50_ms", "p99_ms", "esc_rate",
-             "certfail_rate", "abft c/d", "err_rate"], rows))
+            ["op", "calls", "traced", "p50_ms", "p99_ms", "dev_p50_ms",
+             "mfu", "esc_rate", "certfail_rate", "abft c/d", "err_rate"],
+            rows))
     if summary["plans"]:
         rows = [[k, v] for k, v in summary["plans"].items()]
         parts.append("\nplan usage\n" + _table(["plan", "calls"], rows))
     if summary.get("serve"):
         rows = [[key, s["batches"], s["problems"], s["occupancy_p50"],
                  s["occupancy_p99"], s["padding_waste_p50"],
-                 s.get("wa_pps"), s["esc_per_1k"], s["retraces"],
-                 s["compiles"]]
+                 s.get("latency_p50_ms"), s.get("latency_p99_ms"),
+                 s.get("mfu"), s.get("wa_pps"), s["esc_per_1k"],
+                 s["retraces"], s["compiles"]]
                 for key, s in summary["serve"].items()]
         parts.append("\nserving\n" + _table(
             ["op/dtype", "batches", "problems", "occ_p50", "occ_p99",
-             "waste_p50", "wa_pps", "esc/1k", "retraces", "compiles"],
+             "waste_p50", "lat_p50_ms", "lat_p99_ms", "mfu", "wa_pps",
+             "esc/1k", "retraces", "compiles"],
             rows))
     bench = summary["bench"]
     if bench["metrics"]:
@@ -279,4 +351,7 @@ def render(summary: dict) -> str:
     if bench["errors"]:
         rows = [[e["metric"], e.get("error")] for e in bench["errors"]]
         parts.append("\nbench errors\n" + _table(["metric", "error"], rows))
+    if c.get("malformed"):
+        parts.append(f"\nmalformed={c['malformed']} truncated/garbled "
+                     f"line(s) skipped")
     return "\n".join(parts) + "\n"

@@ -1,0 +1,152 @@
+"""SLO verdicts over serve_batch event streams.
+
+The serving flight recorder (serve/server.py) stamps every request at
+submit and every batch at flush, so a stream of ``serve_batch`` records
+carries everything a serving SLO needs: per-problem submit->drain
+latency, padding waste, escalations, waste-adjusted throughput, and
+(under ``obs.timing()``) device-time MFU.  This module turns such a
+stream into pass/fail verdicts against DECLARED budgets — the Ragged
+Paged Attention evaluation style of reporting (PAPERS.md): tail latency
+and waste-adjusted throughput as first-class serving metrics, not
+bench-day footnotes.
+
+Budgets are a JSON object mapping a target — an ``op/dtype`` key as the
+serving table prints it, a bare op (any dtype), or ``"*"`` for the
+whole stream — to bounds per metric::
+
+    {
+      "*":             {"latency_p99_ms": 250, "esc_per_1k": 5},
+      "solve/float32": {"wa_pps": 120, "padding_waste_p50": 0.35}
+    }
+
+The bound's DIRECTION is a property of the metric, not the file:
+latency / waste / age / escalations are maxima, throughput / occupancy
+/ mfu are minima (:data:`METRIC_DIRECTION`).  A budget naming a metric
+the stream has no data for FAILS — an SLO that silently passes because
+nothing was measured is how regressions ship.
+
+CLI: ``python -m slate_tpu.obs --slo budgets.json events.jsonl``
+(exit 0 all pass, 1 any fail); ``--prom`` emits the aggregate as
+Prometheus-style text instead of tables.
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import metrics as _metrics
+
+#: metric -> "max" (bound is a ceiling) or "min" (bound is a floor)
+METRIC_DIRECTION = {
+    "latency_p50_ms": "max", "latency_p99_ms": "max", "age_p99_ms": "max",
+    "padding_waste_p50": "max", "esc_per_1k": "max", "retraces": "max",
+    "compiles": "max",
+    "occupancy_p50": "min", "occupancy_p99": "min", "wa_pps": "min",
+    "mfu": "min", "problems": "min", "batches": "min",
+}
+
+
+def aggregate(records) -> dict:
+    """Per-``op/dtype`` serving stats plus an ``"*"`` union row, from
+    any mixed record list (non-serve records are ignored)."""
+    serve = _metrics.split_records(records)[2]
+    table = _metrics.summarize_serve(serve)
+    if serve:
+        union = _metrics.summarize_serve(
+            [{**e, "op": "*", "dtype": "all"} for e in serve])
+        table["*"] = next(iter(union.values()))
+    return table
+
+
+def _rows_for(stats: dict, target: str) -> list[tuple[str, dict]]:
+    if target in stats:
+        return [(target, stats[target])]
+    # bare-op target: every dtype row of that op
+    return [(k, s) for k, s in stats.items()
+            if k.split("/")[0] == target]
+
+
+def evaluate(stats: dict, budgets: dict) -> list[dict]:
+    """Budget verdicts, one per (target row, metric bound).
+
+    Each verdict: ``target`` (budget key), ``row`` (matched stats row),
+    ``metric``, ``value`` (measured, None = no data), ``bound``,
+    ``direction``, ``ok``.  Unknown metrics and targets with no
+    matching data fail loudly (``value=None, ok=False``)."""
+    verdicts = []
+    for target in sorted(budgets):
+        bounds = budgets[target]
+        rows = _rows_for(stats, target)
+        if not rows:
+            for metric in sorted(bounds):
+                verdicts.append({
+                    "target": target, "row": None, "metric": metric,
+                    "value": None, "bound": bounds[metric],
+                    "direction": METRIC_DIRECTION.get(metric, "max"),
+                    "ok": False})
+            continue
+        for row_key, row in rows:
+            for metric in sorted(bounds):
+                bound = bounds[metric]
+                direction = METRIC_DIRECTION.get(metric, "max")
+                value = row.get(metric)
+                if not isinstance(value, (int, float)):
+                    ok, value = False, None
+                elif direction == "max":
+                    ok = value <= bound
+                else:
+                    ok = value >= bound
+                verdicts.append({
+                    "target": target, "row": row_key, "metric": metric,
+                    "value": value, "bound": bound,
+                    "direction": direction, "ok": ok})
+    return verdicts
+
+
+def load_budgets(path) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        budgets = json.load(fh)
+    if not isinstance(budgets, dict) or not all(
+            isinstance(v, dict) for v in budgets.values()):
+        raise ValueError(
+            f"{path}: budgets must be {{target: {{metric: bound}}}}")
+    return budgets
+
+
+def render_verdicts(verdicts) -> str:
+    rows = [[v["target"], v["row"] or "-", v["metric"],
+             v["value"] if v["value"] is not None else "no-data",
+             ("<=" if v["direction"] == "max" else ">=") + _metrics._fmt(
+                 v["bound"]),
+             "PASS" if v["ok"] else "FAIL"]
+            for v in verdicts]
+    failed = sum(1 for v in verdicts if not v["ok"])
+    table = _metrics._table(
+        ["budget", "row", "metric", "value", "bound", "verdict"], rows)
+    return (f"slo\n{table}\n\n"
+            f"slo: {len(verdicts) - failed}/{len(verdicts)} budget "
+            f"check(s) passed\n")
+
+
+def export_prometheus(stats: dict) -> str:
+    """The aggregated serving stats as Prometheus-style text — one
+    ``slate_serve_<metric>{op=...,dtype=...}`` gauge per numeric stat
+    (the ``"*"`` union row exports with ``op="*"``)."""
+    seen_help = set()
+    lines = []
+    for key in sorted(stats):
+        op, _, dtype = key.partition("/")
+        labels = f'op="{op}",dtype="{dtype}"'
+        for metric in sorted(stats[key]):
+            value = stats[key][metric]
+            if not isinstance(value, (int, float)) or isinstance(value,
+                                                                 bool):
+                continue
+            name = "slate_serve_" + metric.replace("/", "_")
+            if name not in seen_help:
+                seen_help.add(name)
+                lines.append(f"# HELP {name} serving aggregate "
+                             f"{metric} (slate_tpu.obs.slo)")
+                lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{{{labels}}} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -27,6 +27,7 @@ and surfaced in the per-batch obs events.
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 
@@ -55,17 +56,20 @@ class ExecutableCache:
         self._exes: dict = {}
         self._hits = 0
         self._misses = 0
+        self._compile_ms = 0.0
 
     def stats(self) -> dict:
         with self._lock:
             return {"entries": len(self._exes), "hits": self._hits,
-                    "misses": self._misses}
+                    "misses": self._misses,
+                    "compile_ms": round(self._compile_ms, 3)}
 
     def clear(self) -> None:
         with self._lock:
             self._exes.clear()
             self._hits = 0
             self._misses = 0
+            self._compile_ms = 0.0
 
     def get_or_compile(self, op: str, bucket_shape: tuple, dtype,
                        batch: int, opts: Options | None = None):
@@ -91,10 +95,13 @@ class ExecutableCache:
                 return exe, True
         # compile OUTSIDE the lock (it can take seconds); a racing
         # duplicate compile is wasted work, not a correctness problem
+        t0 = time.perf_counter()
         exe = self._compile(op, key[1], dtype, int(batch), opts)
+        dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             winner = self._exes.setdefault(key, exe)
             self._misses += 1
+            self._compile_ms += dt_ms
         return winner, False
 
     @staticmethod

@@ -21,17 +21,30 @@ executed batch (obs.events.emit_serve_batch) carrying bucket occupancy,
 padding waste, escalations, executable-cache stats and the retrace
 delta observed across the execution — the fields ``python -m
 slate_tpu.obs`` aggregates into the serving table.
+
+The server is also a flight recorder: every request is stamped at
+submit, so each ``serve_batch`` event additionally carries
+``queue_depth`` (pending requests when drain started), per-problem
+``age_at_flush_ms`` (submit -> drain start) and ``latency_ms``
+(submit -> result materialized) — the tail-latency inputs
+``obs.slo`` aggregates into p50/p99 verdicts.  Under ``obs.timing()``
+the batch also reports ``device_ms`` (dispatch -> device-ready) and a
+waste-adjusted ``mfu`` priced over LIVE problem flops only
+(obs.flops.serve_flops), so padding can never inflate utilization.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..obs import events as _events
+from ..obs import flops as _flops
 from ..obs import sentinel as _sentinel
 from ..options import Options
 from ..robust.health import HealthInfo
@@ -42,10 +55,12 @@ SERVE_OPS = ("solve", "chol_solve", "least_squares_solve")
 
 
 class Request(NamedTuple):
-    """One pending problem: ``op`` in SERVE_OPS, dense ``a``/``b``."""
+    """One pending problem: ``op`` in SERVE_OPS, dense ``a``/``b``,
+    and the flight-recorder submit stamp (perf_counter seconds)."""
     op: str
     a: np.ndarray
     b: np.ndarray
+    t_submit: float = 0.0
 
 
 class Result(NamedTuple):
@@ -78,6 +93,10 @@ class Server:
         self.opts = dict(opts or {})
         self._ladder = ladder
         self.cache = cache if cache is not None else _cache.default_cache()
+        # submit/drain may come from different threads (a web front end
+        # submitting while a drain loop flushes); the queue swap must be
+        # atomic or tickets tear
+        self._lock = threading.Lock()
         self._pending: list[Request] = []
 
     # ------------------------------------------------------------ intake
@@ -106,8 +125,9 @@ class Server:
         if b.shape[0] != a.shape[0]:
             raise ValueError(f"serve: A {a.shape} / B {b.shape} row "
                              "mismatch")
-        self._pending.append(Request(op, a, b))
-        return len(self._pending) - 1
+        with self._lock:
+            self._pending.append(Request(op, a, b, time.perf_counter()))
+            return len(self._pending) - 1
 
     def serve_batch(self, requests) -> list:
         """Synchronous convenience: submit every (op, a, b) and drain."""
@@ -126,9 +146,11 @@ class Server:
 
     def drain(self) -> list:
         """Execute every pending request; results in submit order."""
-        pending, self._pending = self._pending, []
+        with self._lock:
+            pending, self._pending = self._pending, []
         if not pending:
             return []
+        t_flush = time.perf_counter()
         groups: dict = {}
         for ticket, req in enumerate(pending):
             key = (req.op, str(req.a.dtype), self._bucket_of(req))
@@ -137,11 +159,13 @@ class Server:
         for key in sorted(groups, key=repr):
             op, dtype, shape = key
             for ticket, res in self._run_group(op, dtype, shape,
-                                               groups[key]):
+                                               groups[key], t_flush,
+                                               len(pending)):
                 results[ticket] = res
         return results
 
-    def _run_group(self, op: str, dtype: str, shape: tuple, members):
+    def _run_group(self, op: str, dtype: str, shape: tuple, members,
+                   t_flush: float, queue_depth: int):
         t0 = time.perf_counter()
         n_real = len(members)
         batch = _bucket.next_pow2(n_real)
@@ -175,8 +199,13 @@ class Server:
                                              self.opts)
         # b is DONATED to the executable (cache.py's contract): hand it
         # a fresh device array and never touch that buffer again
+        t_exec = time.perf_counter()
         x, h, esc = exe(jnp.asarray(a_pad), jnp.asarray(b_pad),
                         jnp.asarray(sizes))
+        device_ms = None
+        if _events.timing_enabled():
+            x, h, esc = jax.block_until_ready((x, h, esc))
+            device_ms = round((time.perf_counter() - t_exec) * 1e3, 3)
         x = np.asarray(x)
         esc = np.asarray(esc)
         h_np = HealthInfo(*(np.asarray(leaf) for leaf in h))
@@ -189,6 +218,22 @@ class Server:
                 x[slot, :n_i, :k_i],
                 HealthInfo(*(leaf[slot] for leaf in h_np)),
                 bool(esc[slot]))))
+
+        t_done = time.perf_counter()
+        ages = [round((t_flush - req.t_submit) * 1e3, 3)
+                for _, req in members]
+        latency = [round((t_done - req.t_submit) * 1e3, 3)
+                   for _, req in members]
+        mfu = gbps = None
+        if device_ms:
+            secs = device_ms * 1e-3
+            # waste-adjusted by construction: LIVE problem flops only
+            mfu = _flops.mfu(_flops.serve_flops(
+                op, [(req.a.shape, req.b.shape) for _, req in members]),
+                secs)
+            item = np.dtype(dtype).itemsize
+            gbps = _flops.achieved_gbps(
+                float(batch) * (mb * nb + 2 * mb * kb) * item, secs)
 
         bucket_elems = batch * (mb * nb + mb * kb)
         _events.emit_serve_batch({
@@ -206,6 +251,12 @@ class Server:
             "retraces": retraces,
             "ladder": self.ladder(dtype).source,
             "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "device_ms": device_ms,
+            "mfu": mfu,
+            "achieved_gbps": gbps,
+            "queue_depth": queue_depth,
+            "age_at_flush_ms": ages,
+            "latency_ms": latency,
         })
         return out
 

@@ -59,7 +59,14 @@ def span(name: str):
 
 def annotate(name: str):
     """Decorator form of :func:`span` for whole drivers — also the
-    structured-event boundary: one obs event per outermost call."""
+    structured-event boundary: one obs event per outermost call.
+
+    Under ``obs.timing()`` the outermost eager boundary additionally
+    blocks until the result is device-ready before closing, so its event
+    carries a true dispatch->ready ``device_ms`` (and derived mfu /
+    achieved_gbps).  The sync is host-side and never runs while tracing
+    — ``should_time`` refuses traced frames — so enabling timing cannot
+    change a jaxpr."""
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
@@ -67,6 +74,9 @@ def annotate(name: str):
             try:
                 with span(name):
                     out = fn(*args, **kwargs)
+                if _events.should_time(tok):
+                    jax.block_until_ready(out)
+                    _events.note_device_ready(tok)
             except BaseException as e:
                 _events.boundary_exit(tok, error=e)
                 # slate-lint: disable=TRC006 -- bare re-raise after noting

@@ -286,3 +286,39 @@ def test_sweep_nb_mode_emits_candidate_lines(bench, capsys, monkeypatch):
         assert isinstance(line["nb"], int) and isinstance(line["bw"], int)
         assert line["unit"] == "GFLOP/s"
         assert line["value"] > 0
+
+
+def test_bench_lines_priced_from_obs_flops_registry(bench, capsys,
+                                                    monkeypatch):
+    """One registry, two consumers: a bench line's flops count is the
+    obs.flops model verbatim, and its mfu agrees with what a timed obs
+    event would compute from the same flops/seconds measurement."""
+    import math
+
+    from slate_tpu.obs import flops
+
+    monkeypatch.setattr(bench, "PEAK", 1e12)
+    bench.bench_gemm(n=64, nb=32, iters=2)
+    (line,) = _lines(capsys)
+    assert line["flops"] == flops.op_flops("gemm", [(64, 64), (64, 64)])
+    assert line["device_ms"] is not None and line["device_ms"] > 0
+    assert isinstance(line["mfu"], float) and line["mfu"] > 0
+    with flops.peak_override(1e12):
+        event_style = flops.mfu(line["flops"], line["device_ms"] * 1e-3)
+    assert event_style is not None
+    # bench prices from the unrounded seconds; allow the device_ms
+    # round-trip (1 µs quantization) plus the two mfu roundings
+    assert math.isclose(line["mfu"], event_style, rel_tol=0.05,
+                        abs_tol=5e-4)
+
+
+def test_bench_lines_carry_device_ms_and_flops(bench, capsys):
+    bench.bench_posv(n=64, nb=32, nrhs=4, iters=1)
+    (line,) = _lines(capsys)
+    from slate_tpu.obs import flops
+    assert line["flops"] == flops.op_flops("posv", [(64, 64), (64, 4)])
+    assert line["device_ms"] > 0
+    # GFLOP/s, flops and device_ms must be one consistent measurement
+    derived = line["flops"] / (line["device_ms"] * 1e-3) / 1e9
+    import math
+    assert math.isclose(derived, line["value"], rel_tol=0.05)

@@ -404,3 +404,44 @@ def test_env_var_configures_recording(tmp_path, monkeypatch):
     finally:
         obs.disable()
         obs_events.configure(path="")
+
+
+def test_chrome_export_preserves_span_nesting(rng):
+    """The Perfetto/Chrome export must keep nested phase spans INSIDE
+    their boundary span on the timeline: depth parent+1, same tid, and
+    the child's [ts, ts+dur] interval contained in the parent's — that
+    containment is what makes the rendered flame graph truthful."""
+    import tempfile
+    n = 32
+    a = rng.standard_normal((n, n))
+    A = st.HermitianMatrix.from_numpy(a + a.T, 16, st.Uplo.Lower)
+    with obs.record_spans() as rec:
+        st.heev(A)
+    with tempfile.TemporaryDirectory() as d:
+        path = d + "/trace.json"
+        rec.export_chrome_trace(path)
+        with open(path, encoding="utf-8") as fh:
+            events = json.load(fh)["traceEvents"]
+
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    (parent,) = by_name["slate.heev"]
+    assert parent["args"]["depth"] == 1
+    children = [e for name, evs in by_name.items() if name != "slate.heev"
+                and name.startswith("slate.heev/") for e in evs]
+    assert {e["name"] for e in children} >= {"slate.heev/he2hb",
+                                             "slate.heev/stage2"}
+    eps = 0.5                               # µs: ts/dur each round to 0.1
+    p0, p1 = parent["ts"], parent["ts"] + parent["dur"]
+    for ch in children:
+        assert ch["args"]["depth"] >= parent["args"]["depth"] + 1
+        assert ch["tid"] == parent["tid"]
+        assert ch["ts"] >= p0 - eps
+        assert ch["ts"] + ch["dur"] <= p1 + eps
+        assert ch["dur"] <= parent["dur"]
+    # phases must not overlap each other: he2hb finishes before stage2
+    he2hb = [c for c in children if c["name"] == "slate.heev/he2hb"]
+    stage2 = [c for c in children if c["name"] == "slate.heev/stage2"]
+    assert he2hb and stage2
+    assert he2hb[0]["ts"] + he2hb[0]["dur"] <= stage2[0]["ts"] + eps

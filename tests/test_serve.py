@@ -426,3 +426,128 @@ def test_metrics_serving_table(tmp_path):
     text = metrics.render(summary)
     assert "serving" in text and "solve/float32" in text
     assert "esc/1k" in text and "wa_pps" in text
+
+
+# ---------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_stamps_every_problem():
+    """Every serve_batch event carries the drain-time queue depth and
+    per-problem submit->flush age / submit->result latency lists — the
+    tail-latency inputs obs.slo aggregates."""
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    reqs = [("solve", *_mk_solve(rng, n, 2, np.float64))
+            for n in (20, 24, 40)]          # buckets 32, 32, 64
+    with obs.recording() as recs:
+        srv.serve_batch(reqs)
+    evs = _serve_events(recs)
+    assert len(evs) == 2                    # two buckets
+    assert sum(e["problems"] for e in evs) == 3
+    for e in evs:
+        assert e["queue_depth"] == 3        # whole drain, not this batch
+        assert len(e["age_at_flush_ms"]) == e["problems"]
+        assert len(e["latency_ms"]) == e["problems"]
+        for age, lat in zip(e["age_at_flush_ms"], e["latency_ms"]):
+            assert 0.0 <= age < lat         # result lands after flush
+
+
+def test_flight_recorder_latency_reaches_serving_table(tmp_path):
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as recs:
+        srv.serve_batch([("solve", *_mk_solve(rng, 20, 2, np.float64))
+                         for _ in range(3)])
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in recs))
+    row = obs.summarize([str(path)])["serve"]["solve/float64"]
+    assert row["latency_p50_ms"] is not None and row["latency_p50_ms"] > 0
+    assert row["latency_p99_ms"] >= row["latency_p50_ms"]
+    assert row["age_p99_ms"] is not None
+    from slate_tpu.obs import metrics
+    text = metrics.render(obs.summarize([str(path)]))
+    assert "lat_p50_ms" in text and "lat_p99_ms" in text
+
+
+def test_warm_server_zero_retrace_with_timing_on():
+    """Timing mode is serving-safe: the block_until_ready sync happens
+    after execution, outside tracing, so a warmed server stays warm with
+    timing ON — and its events carry device_ms plus a waste-adjusted mfu
+    priced over live problem flops only."""
+    from slate_tpu.obs import flops
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    reqs = [("solve", *_mk_solve(rng, 20, 2, np.float64))
+            for _ in range(2)]
+    srv.serve_batch(reqs)                    # warm (timing off)
+    entries0 = srv.cache.stats()["entries"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.SlateRetraceWarning)
+        with flops.peak_override(1e12), obs.timing():
+            with obs.recording() as recs:
+                results = srv.serve_batch(reqs)
+    for req, res in zip(reqs, results):
+        _check(req, res)
+    (ev,) = _serve_events(recs)
+    assert not ev["compiled"] and ev["retraces"] == 0
+    assert srv.cache.stats()["entries"] == entries0
+    assert ev["device_ms"] is not None and ev["device_ms"] > 0
+    # waste-adjusted by construction: live flops only, never the bucket's
+    with flops.peak_override(1e12):
+        expected = flops.mfu(
+            flops.serve_flops("solve", [(a.shape, b.shape)
+                                        for _, a, b in reqs]),
+            ev["device_ms"] * 1e-3)
+    assert expected is not None and ev["mfu"] == expected
+    assert ev["achieved_gbps"] is not None
+
+
+def test_serve_events_timing_off_fields_none():
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with obs.recording() as recs:
+        srv.serve_batch([("solve", *_mk_solve(rng, 20, 2, np.float64))])
+    (ev,) = _serve_events(recs)
+    assert ev["device_ms"] is None
+    assert ev["mfu"] is None and ev["achieved_gbps"] is None
+
+
+def test_concurrent_submit_while_draining():
+    """submit/drain hold the queue lock: threads hammering submit while
+    drains flush never tear tickets or lose problems."""
+    import threading
+    rng = _workload_rng()
+    a, b = _mk_solve(rng, 16, 2, np.float64)
+    srv = serve.Server(cache=serve.ExecutableCache())
+    srv.serve_batch([("solve", a, b)])       # compile outside the race
+    per_thread, n_threads = 8, 4
+    start = threading.Barrier(n_threads)
+
+    def pound():
+        start.wait()
+        for _ in range(per_thread):
+            srv.submit("solve", a, b)
+
+    threads = [threading.Thread(target=pound) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = srv.drain()
+    assert len(results) == per_thread * n_threads
+    want = np.linalg.solve(a, b)
+    for res in results:
+        assert res is not None
+        np.testing.assert_allclose(res.x, want, rtol=1e-9, atol=1e-9)
+    assert srv.drain() == []                 # queue fully swapped out
+
+
+def test_cache_stats_report_compile_time():
+    rng = _workload_rng()
+    srv = serve.Server(cache=serve.ExecutableCache())
+    assert srv.cache.stats()["compile_ms"] == 0.0
+    srv.serve_batch([("solve", *_mk_solve(rng, 20, 2, np.float64))])
+    cold_ms = srv.cache.stats()["compile_ms"]
+    assert cold_ms > 0
+    srv.serve_batch([("solve", *_mk_solve(rng, 20, 2, np.float64))])
+    assert srv.cache.stats()["compile_ms"] == cold_ms   # hits are free

@@ -991,3 +991,78 @@ def test_repo_is_lint_clean(capsys):
 def test_repo_baseline_is_empty():
     assert json.loads(
         (REPO / "tools/slate_lint/baseline.json").read_text()) == []
+
+
+# --------------------------------------------------------------------------
+# observability pack (OBS002)
+
+
+FLOPS_FIXTURE = """\
+    def register(*names):
+        def deco(fn):
+            return fn
+        return deco
+
+
+    @register("gesv", "posv")
+    def _f(shapes, sizes):
+        return 1.0
+    """
+
+
+def test_obs002_fires_on_unpriced_driver(tmp_path):
+    """An @annotate-decorated driver whose op has no flops model in
+    obs/flops.py means a silent `mfu: n/a` forever — OBS002 flags the
+    decorator line."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/obs/flops.py": FLOPS_FIXTURE,
+        "slate_tpu/drivers/qr.py": (
+            "from ..util.trace import annotate\n\n\n"
+            "@annotate('slate.geqrf')\n"
+            "def geqrf(a, opts=None):\n"
+            "    return a\n"),
+    })
+    fs = lint(root, {"OBS002"})
+    assert rule_ids(fs) == {"OBS002"}
+    (f,) = fs
+    assert f.path == "slate_tpu/drivers/qr.py" and f.line == 4
+    assert "geqrf" in f.message and "flops model" in f.message
+
+
+def test_obs002_silent_on_registered_or_disabled(tmp_path):
+    """Registered ops pass; unregistered ops with an explicit reasoned
+    disable (the band-driver pattern) pass too."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/obs/flops.py": FLOPS_FIXTURE,
+        "slate_tpu/drivers/lu.py": (
+            "from ..util.trace import annotate\n\n\n"
+            "@annotate('slate.gesv')\n"
+            "def gesv(a, b, opts=None):\n"
+            "    return a\n"),
+        "slate_tpu/drivers/band.py": (
+            "from ..util.trace import annotate\n\n\n"
+            "@annotate('slate.pbsv')  "
+            "# slate-lint: disable=OBS002 -- needs bandwidth, not shapes\n"
+            "def pbsv(a, b, opts=None):\n"
+            "    return a\n"),
+    })
+    assert lint(root, {"OBS002"}) == []
+
+
+def test_obs002_silent_without_flops_module(tmp_path):
+    """Mini-repos with no obs/flops.py have no registry to check against;
+    the rule stands down instead of flagging everything."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/drivers/qr.py": (
+            "from ..util.trace import annotate\n\n\n"
+            "@annotate('slate.geqrf')\n"
+            "def geqrf(a, opts=None):\n"
+            "    return a\n"),
+    })
+    assert lint(root, {"OBS002"}) == []
+
+
+def test_obs002_clean_on_live_repo():
+    """The real tree holds the invariant: every annotate-decorated driver
+    is either priced in obs/flops.py or carries a reasoned disable."""
+    assert lint(REPO, {"OBS002"}) == []

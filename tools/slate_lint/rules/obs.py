@@ -12,6 +12,15 @@ jaxpr-identity guarantee tests/test_obs.py enforces).
 
 ``drivers/printing.py`` is exempt: pretty-printing matrices to stdout is
 its entire contract.
+
+OBS002 — every ``@annotate("slate.<op>")``-decorated public driver has a
+flops model registered in ``slate_tpu/obs/flops.py`` (the decorator's
+``@register("<op>", ...)`` string literals are the source of truth —
+the rule reads both sides by AST, never importing jax).  Without a
+model, the op's events read ``mfu: n/a`` forever and nobody notices;
+with this rule, skipping the model is an EXPLICIT
+``# slate-lint: disable=OBS002 -- reason`` on the decorator line (the
+band drivers do this: bandwidth is not recoverable from event shapes).
 """
 
 from __future__ import annotations
@@ -92,3 +101,80 @@ class Obs001(Rule):
                             f"calls {what} — drivers/internal/parallel emit "
                             f"telemetry only through the obs spine "
                             f"(util.trace.annotate / span / obs.events)")
+
+
+#: the one module whose @register("<op>") literals define the model set
+FLOPS_MODULE = "slate_tpu/obs/flops.py"
+
+
+def _registered_flops_ops(project) -> set | None:
+    """Op names registered in FLOPS_MODULE, by AST literal scan; None when
+    the module is absent (fixture mini-repos without a flops registry are
+    not checked — the live repo always has one)."""
+    cached = project.cache.get("obs002:registered")
+    if cached is not None:
+        return cached or None
+    mod = project.modules.get(FLOPS_MODULE)
+    if mod is None:
+        project.cache["obs002:registered"] = set()
+        return None
+    ops: set = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name != "register":
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                ops.add(arg.value)
+    project.cache["obs002:registered"] = ops
+    return ops
+
+
+def _annotate_op(dec) -> str | None:
+    """The 'slate.<op>' literal of an @annotate decorator Call, if any."""
+    if not isinstance(dec, ast.Call) or not dec.args:
+        return None
+    f = dec.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if name != "annotate":
+        return None
+    arg = dec.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and arg.value.startswith("slate."):
+        return arg.value[len("slate."):]
+    return None
+
+
+@register
+class Obs002(Rule):
+    id = "OBS002"
+    summary = ("every @annotate-decorated public driver has a flops model "
+               "registered in obs/flops.py (or an explicit disable) — the "
+               "MFU column never silently reads n/a for a new op")
+
+    def run(self, project):
+        registered = _registered_flops_ops(project)
+        if registered is None:
+            return
+        for rel in sorted(project.modules):
+            if not rel.startswith("slate_tpu/"):
+                continue
+            mod = project.modules[rel]
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for dec in node.decorator_list:
+                    op = _annotate_op(dec)
+                    if op is not None and op not in registered:
+                        yield Finding(
+                            self.id, rel, dec.lineno,
+                            f"driver `{node.name}` (slate.{op}) has no "
+                            f"flops model in obs/flops.py — register one "
+                            f"(@register(\"{op}\")) or disable with a "
+                            f"reason")
