@@ -79,6 +79,7 @@ def _frames() -> list:
 
 
 def _active() -> bool:
+    # slate-lint: disable=CON001 -- designed lock-free peek on the per-call fast path: a stale read only delays one event past a concurrent enable/disable, never tears (dict read is atomic under the GIL)
     return _CFG["enabled"] or bool(_COLLECTORS)
 
 
@@ -120,7 +121,7 @@ def recording(path: str | None = None):
     events: list = []
     with _LOCK:
         _COLLECTORS.append(events)
-    prev_path = _CFG["path"]
+        prev_path = _CFG["path"]
     if path is not None:
         configure(path=path)
     try:
@@ -157,6 +158,7 @@ def clear() -> None:
 def timing_enabled() -> bool:
     """Is device-time measurement on (``obs.timing()`` or
     ``SLATE_OBS_TIMING=1``)?"""
+    # slate-lint: disable=CON001 -- designed lock-free peek on the per-call fast path: one boundary may miss a concurrent toggle, which is benign (atomic dict read under the GIL)
     return _CFG["timing"]
 
 
@@ -169,7 +171,8 @@ def set_timing(on: bool) -> None:
 def timing(on: bool = True):
     """Scope device-time measurement: events gain ``device_ms`` /
     ``mfu`` / ``achieved_gbps`` (None outside the scope)."""
-    prev = _CFG["timing"]
+    with _LOCK:
+        prev = _CFG["timing"]
     set_timing(on)
     try:
         yield
@@ -181,6 +184,7 @@ def should_time(token) -> bool:
     """Should the annotate wrapper block_until_ready for this boundary?
     Only the OUTERMOST eager frame with timing on — nested boundaries
     would double-sync, and traced frames hold tracers, not buffers."""
+    # slate-lint: disable=CON001 -- designed lock-free peek on the per-call fast path: one boundary may miss a concurrent toggle, which is benign (atomic dict read under the GIL)
     if token is None or not _CFG["timing"] or token.traced:
         return False
     frames = _frames()

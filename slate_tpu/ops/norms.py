@@ -53,6 +53,7 @@ def ge_norm(norm: Norm, a_tiles, m, n, mb, nb):
     if norm is Norm.Fro:
         scale, s = _sumsq_scaled(absa)
         return scale * jnp.sqrt(s)
+    # slate-lint: disable=TRC006 -- static Norm enum fall-through: fails at trace time, never in-graph
     raise ValueError(norm)
 
 
@@ -85,6 +86,7 @@ def tr_norm(norm: Norm, a_tiles, m, n, mb, nb, uplo_lower, unit_diag=False):
     if norm is Norm.Fro:
         scale, s = _sumsq_scaled(absa)
         return scale * jnp.sqrt(s)
+    # slate-lint: disable=TRC006 -- static Norm enum fall-through: fails at trace time, never in-graph
     raise ValueError(norm)
 
 
@@ -123,6 +125,7 @@ def sy_norm(norm: Norm, a_tiles, n, nb, uplo_lower, hermitian=False):
         dscale, ds = _sumsq_scaled(diag)
         tot = jnp.sqrt(2.0 * (scale ** 2) * s + (dscale ** 2) * ds)
         return tot
+    # slate-lint: disable=TRC006 -- static Norm enum fall-through: fails at trace time, never in-graph
     raise ValueError(norm)
 
 
@@ -149,6 +152,7 @@ def gb_norm(norm: Norm, a_tiles, m, n, mb, nb, kl, ku):
     if norm is Norm.Fro:
         scale, s = _sumsq_scaled(absa)
         return scale * jnp.sqrt(s)
+    # slate-lint: disable=TRC006 -- static Norm enum fall-through: fails at trace time, never in-graph
     raise ValueError(norm)
 
 
@@ -171,4 +175,5 @@ def hb_norm(norm: Norm, a_tiles, n, nb, kd, uplo_lower):
         diag = _masked(a_tiles, mask & ~stri)
         dscale, ds = _sumsq_scaled(diag)
         return jnp.sqrt(2.0 * (oscale ** 2) * os + (dscale ** 2) * ds)
+    # slate-lint: disable=TRC006 -- static Norm enum fall-through: fails at trace time, never in-graph
     raise ValueError(norm)

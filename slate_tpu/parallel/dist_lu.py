@@ -317,7 +317,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 return a_loc, perm_g, loc
 
             if S > 0 and T > 0:
-                # slate-lint: disable=COL003 -- k is the replicated fori_loop index and Nt is static: every rank evaluates the same predicate, so the psum branch is taken mesh-uniformly
+                # slate-lint: disable=COL003,COL005 -- k is the replicated fori_loop index and Nt is static: every rank evaluates the same predicate, so the psum branch is taken mesh-uniformly
                 a_loc, perm_g, loc = lax.cond(k < Nt - 1, tail,
                                               lambda cr: cr,
                                               (a_loc, perm_g, loc))

@@ -973,8 +973,8 @@ def test_obs001_silent_on_obs_spine_and_printing(tmp_path):
 
 
 def test_registry_has_required_rule_surface():
-    assert len(REGISTRY) >= 14
-    packs = {"TRC", "COL", "SEAM", "OBS"}
+    assert len(REGISTRY) >= 30
+    packs = {"TRC", "COL", "SEAM", "OBS", "CON"}
     assert {r[:3] if not r.startswith("SEAM") else "SEAM"
             for r in REGISTRY} == packs
 
@@ -983,9 +983,19 @@ def test_registry_has_required_rule_surface():
 # tier-1: the live repo is lint-clean with an empty baseline diff
 
 
-def test_repo_is_lint_clean(capsys):
-    assert cli.main(["--root", str(REPO)]) == 0
+def test_repo_is_lint_clean(tmp_path, capsys):
+    """The tier-1 gate AND artifact: the repo is clean under the full
+    rule surface (all packs, call graph enabled) and the JSON report CI
+    archives says so explicitly."""
+    artifact = tmp_path / "slate-lint.json"
+    assert cli.main(["--root", str(REPO), "--output", str(artifact)]) == 0
     capsys.readouterr()
+    report = json.loads(artifact.read_text())
+    assert report["findings"] == []
+    assert report["baselined"] == 0 and report["stale_baseline"] == []
+    assert len(report["rules"]) >= 30
+    for pack in ("TRC", "COL", "SEAM", "OBS", "CON"):
+        assert any(r.startswith(pack) for r in report["rules"])
 
 
 def test_repo_baseline_is_empty():
@@ -1066,3 +1076,676 @@ def test_obs002_clean_on_live_repo():
     """The real tree holds the invariant: every annotate-decorated driver
     is either priced in obs/flops.py or carries a reasoned disable."""
     assert lint(REPO, {"OBS002"}) == []
+
+
+# --------------------------------------------------------------------------
+# call graph: re-export, dict-dispatch, and method edges
+
+
+def test_reexport_edge_traces_through_init(tmp_path):
+    """pkg.work where pkg/__init__.py merely re-exports work from a
+    submodule: dotted resolution follows the import chain to the def."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/pkg/__init__.py": "from .impl import work\n",
+        "slate_tpu/pkg/impl.py": "def work(x):\n    return x\n",
+        "slate_tpu/mod.py": """\
+            import jax
+            from . import pkg
+
+
+            @jax.jit
+            def entry(x):
+                return pkg.work(x)
+            """,
+    })
+    reach = reachability.compute(load_project(root))
+    assert "slate_tpu/pkg/impl.py::work" in reach.traced
+
+
+def test_dispatch_table_call_and_alias_edges(tmp_path):
+    """The serve.CORES idiom: CORES[op](...) and the two-step
+    core = CORES[op]; vmap(lambda ...: core(...)) both reach EVERY
+    table value."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+
+
+        def solve_core(a):
+            return a
+
+
+        def chol_core(a):
+            return a
+
+
+        CORES = {"solve": solve_core, "chol": chol_core}
+
+
+        def direct(op, a):
+            return CORES[op](a)
+
+
+        def via_alias(op, a):
+            core = CORES[op]
+            return jax.vmap(lambda ai: core(ai))(a)
+        """})
+    reach = reachability.compute(load_project(root))
+    assert reach.dispatch_tables["slate_tpu/mod.py"]["CORES"] == (
+        "slate_tpu/mod.py::solve_core", "slate_tpu/mod.py::chol_core")
+    direct = reach.functions["slate_tpu/mod.py::direct"]
+    assert {"slate_tpu/mod.py::solve_core",
+            "slate_tpu/mod.py::chol_core"} <= direct.resolved_calls
+    # the vmap(lambda: core(...)) closure marks the table values ENTRIES
+    assert reach.functions["slate_tpu/mod.py::solve_core"].is_entry
+    assert "vmap" in reach.entry_kinds["slate_tpu/mod.py::chol_core"]
+
+
+def test_callgraph_facade_method_and_reverse_edges(tmp_path):
+    from tools.slate_lint import callgraph
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        def helper(x):
+            return x
+
+
+        class Box:
+            def outer(self):
+                return self.inner()
+
+            def inner(self):
+                return helper(1)
+        """})
+    cg = callgraph.compute(load_project(root))
+    outer = "slate_tpu/mod.py::Box.outer"
+    inner = "slate_tpu/mod.py::Box.inner"
+    helper = "slate_tpu/mod.py::helper"
+    assert inner in cg.callees(outer)
+    assert helper in cg.callees(inner)
+    assert outer in cg.callers(inner)
+    assert inner in cg.callers(helper)
+
+
+# --------------------------------------------------------------------------
+# interprocedural taint
+
+
+def test_interprocedural_taint_crosses_modules(tmp_path):
+    """A traced entry passing a traced value into a helper in ANOTHER
+    module taints the helper's parameter: the branch inside fires."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/helper.py": """\
+            def branchy(v):
+                if v > 0:
+                    return v
+                return -v
+            """,
+        "slate_tpu/mod.py": """\
+            import jax
+            import jax.numpy as jnp
+            from . import helper
+
+
+            @jax.jit
+            def entry(x):
+                return helper.branchy(jnp.sum(x))
+            """,
+    })
+    fs = lint(root, {"TRC001"})
+    assert [(f.path, f.line) for f in fs] == [("slate_tpu/helper.py", 2)]
+
+
+def test_interprocedural_taint_respects_annotations(tmp_path):
+    """A parameter annotated with a non-array host type (int) is never
+    interprocedurally seeded — annotations declare the eager contract."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/helper.py": """\
+            def branchy(v: int):
+                if v > 0:
+                    return v
+                return -v
+            """,
+        "slate_tpu/mod.py": """\
+            import jax
+            from . import helper
+
+
+            @jax.jit
+            def entry(x):
+                return helper.branchy(x.shape[0])
+            """,
+    })
+    assert lint(root, {"TRC001"}) == []
+
+
+def test_return_taint_summary_distinguishes_static(tmp_path):
+    """Branching on a callee's return fires only when the callee
+    actually returns traced data — a static .shape return stays clean."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        def size_of(x):
+            return x.shape[0]
+
+
+        def total(x):
+            return jnp.sum(x)
+
+
+        @jax.jit
+        def entry(x):
+            if size_of(x) > 2:
+                x = x * 2
+            if total(x) > 0:
+                x = x + 1
+            return x
+        """})
+    fs = lint(root, {"TRC001"})
+    assert [f.line for f in fs] == [17]
+
+
+def test_return_taint_tuple_elements_are_elementwise(tmp_path):
+    """Tuple-returning callees get element-wise summaries: destructured
+    static elements never taint."""
+    root = mini_repo(tmp_path, {"slate_tpu/mod.py": """\
+        import jax
+
+
+        def padded(x):
+            return x * 2, x.shape[0]
+
+
+        @jax.jit
+        def entry(x):
+            y, n = padded(x)
+            if n > 4:
+                y = y + 1
+            return y
+        """})
+    assert lint(root, {"TRC001"}) == []
+
+
+# --------------------------------------------------------------------------
+# collective-sequence pack (COL005-COL008)
+
+
+COL_GRID = {"slate_tpu/core/grid.py": GRID}
+
+
+def _col_mod(body):
+    return ("import jax\nimport jax.numpy as jnp\n"
+            "from jax import lax\n"
+            "from .core.grid import AXIS_P\n\n\n" + textwrap.dedent(body))
+
+
+def test_col005_fires_on_tainted_predicate(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _yes(x):
+            return lax.psum(x, AXIS_P)
+
+
+        def _no(x):
+            return x
+
+
+        @jax.jit
+        def entry(x):
+            pred = jnp.sum(x) > 0
+            return lax.cond(pred, _yes, _no, x)
+        """)})
+    fs = lint(root, {"COL005"})
+    assert [f.rule for f in fs] == ["COL005"]
+
+
+def test_col005_silent_on_static_predicate(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _yes(x):
+            return lax.psum(x, AXIS_P)
+
+
+        def _no(x):
+            return x
+
+
+        @jax.jit
+        def entry(x):
+            return lax.cond(x.ndim > 1, _yes, _no, x)
+        """)})
+    assert lint(root, {"COL005"}) == []
+
+
+def test_col006_fires_on_differing_branch_sequences(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _a(x):
+            return lax.psum(x, AXIS_P)
+
+
+        def _b(x):
+            return lax.pmax(x, AXIS_P)
+
+
+        @jax.jit
+        def entry(x):
+            return lax.cond(x.ndim > 1, _a, _b, x)
+        """)})
+    fs = lint(root, {"COL006"})
+    assert [f.rule for f in fs] == ["COL006"]
+    assert "psum@p" in fs[0].message and "pmax@p" in fs[0].message
+
+
+def test_col006_silent_on_matching_sequences(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _a(x):
+            return lax.psum(x, AXIS_P) * 2
+
+
+        def _b(x):
+            return lax.psum(x, AXIS_P) + 1
+
+
+        @jax.jit
+        def entry(x):
+            return lax.cond(x.ndim > 1, _a, _b, x)
+        """)})
+    assert lint(root, {"COL006"}) == []
+
+
+def test_col007_fires_on_collective_in_while_loop(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _cond(s):
+            return jnp.sum(s) > 0
+
+
+        def _body(s):
+            return s - lax.psum(s, AXIS_P)
+
+
+        @jax.jit
+        def entry(x):
+            return lax.while_loop(_cond, _body, x)
+        """)})
+    fs = lint(root, {"COL007"})
+    assert [f.rule for f in fs] == ["COL007"]
+
+
+def test_col007_fires_on_fori_with_tainted_bounds(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _body(i, s):
+            return s + lax.psum(s, AXIS_P)
+
+
+        @jax.jit
+        def entry(x, n):
+            return lax.fori_loop(0, n, _body, x)
+        """)})
+    fs = lint(root, {"COL007"})
+    assert [f.rule for f in fs] == ["COL007"]
+
+
+def test_col007_silent_on_static_bounds_and_plain_loops(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _body(i, s):
+            return s + lax.psum(s, AXIS_P)
+
+
+        def _dense_cond(s):
+            return jnp.sum(s) > 0
+
+
+        def _dense_body(s):
+            return s * 0.5
+
+
+        @jax.jit
+        def entry(x):
+            x = lax.fori_loop(0, 8, _body, x)
+            return lax.while_loop(_dense_cond, _dense_body, x)
+        """)})
+    assert lint(root, {"COL007"}) == []
+
+
+def test_col008_fires_on_mismatched_ring_shifts(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def step(x):
+            y = lax.ppermute(x, AXIS_P,
+                             [(i, (i + 1) % 4) for i in range(4)])
+            z = lax.ppermute(x, AXIS_P,
+                             [(i, (i - 1) % 4) for i in range(4)])
+            return y + z
+        """)})
+    fs = lint(root, {"COL008"})
+    assert [f.rule for f in fs] == ["COL008"]
+    assert fs[0].line == 10                  # anchored at the later site
+
+
+def test_col008_silent_on_consistent_ring(tmp_path):
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def step(x):
+            y = lax.ppermute(x, AXIS_P,
+                             [(i, (i + 1) % 4) for i in range(4)])
+            z = lax.ppermute(x, AXIS_P,
+                             [(i, (i + 1) % 4) for i in range(4)])
+            return y + z
+        """)})
+    assert lint(root, {"COL008"}) == []
+
+
+# --------------------------------------------------------------------------
+# lock-discipline pack (CON001-CON003)
+
+
+EVENTS_FIXTURE_HEADER = """\
+import threading
+
+_LOCK = threading.Lock()
+_CFG = {"enabled": False}
+_RING = []
+_COLLECTORS = []
+
+
+"""
+
+
+def test_con001_fires_on_unlocked_module_state(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/obs/events.py": EVENTS_FIXTURE_HEADER + (
+            "def toggle(on):\n"
+            "    _CFG[\"enabled\"] = on\n"),
+    })
+    fs = lint(root, {"CON001"})
+    assert [f.rule for f in fs] == ["CON001"]
+    assert "_CFG" in fs[0].message
+
+
+def test_con001_silent_when_locked_or_suppressed(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/obs/events.py": EVENTS_FIXTURE_HEADER + (
+            "def toggle(on):\n"
+            "    with _LOCK:\n"
+            "        _CFG[\"enabled\"] = on\n\n\n"
+            "def peek():\n"
+            "    # slate-lint: disable=CON001 -- lock-free fast-path peek\n"
+            "    return _CFG[\"enabled\"]\n"),
+    })
+    assert lint(root, {"CON001"}) == []
+
+
+def test_con001_mutation_of_real_server_is_caught(tmp_path):
+    """The acceptance mutation: drop one `with self._lock:` from the real
+    server.py and CON001 must fire; the pristine text stays clean."""
+    real = (REPO / "slate_tpu/serve/server.py").read_text()
+    good = mini_repo(tmp_path / "good",
+                     {"slate_tpu/serve/server.py": real})
+    assert lint(good, {"CON001"}) == []
+    mutated = real.replace("with self._lock:", "if True:", 1)
+    assert mutated != real
+    bad = mini_repo(tmp_path / "bad",
+                    {"slate_tpu/serve/server.py": mutated})
+    fs = lint(bad, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    assert all("_pending" in f.message for f in fs)
+
+
+def test_con002_fires_on_lock_order_inversion(tmp_path, monkeypatch):
+    from tools.slate_lint.rules import concurrency as con
+    monkeypatch.setattr(con, "LOCK_REGISTRY", (
+        con.LockSpec("slate_tpu/a.py", None, "_LA", ("_SA",)),
+        con.LockSpec("slate_tpu/b.py", None, "_LB", ("_SB",)),
+    ))
+    root = mini_repo(tmp_path, {
+        "slate_tpu/a.py": """\
+            import threading
+            from . import b
+
+            _LA = threading.Lock()
+            _SA = []
+
+
+            def take_a():
+                with _LA:
+                    _SA.append(1)
+
+
+            def cross():
+                with _LA:
+                    b.take_b()
+            """,
+        "slate_tpu/b.py": """\
+            import threading
+            from . import a
+
+            _LB = threading.Lock()
+            _SB = []
+
+
+            def take_b():
+                with _LB:
+                    _SB.append(1)
+
+
+            def cross():
+                with _LB:
+                    a.take_a()
+            """,
+    })
+    fs = lint(root, {"CON002"})
+    assert [f.rule for f in fs] == ["CON002"]
+    assert "inversion" in fs[0].message
+
+
+def test_con002_fires_on_self_reacquire(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/obs/events.py": EVENTS_FIXTURE_HEADER + (
+            "def set_on():\n"
+            "    with _LOCK:\n"
+            "        _CFG[\"enabled\"] = True\n\n\n"
+            "def flip():\n"
+            "    with _LOCK:\n"
+            "        set_on()\n"),
+    })
+    fs = lint(root, {"CON002"})
+    assert [f.rule for f in fs] == ["CON002"]
+    assert "re-acquires" in fs[0].message
+
+
+def test_con002_silent_on_consistent_order(tmp_path, monkeypatch):
+    from tools.slate_lint.rules import concurrency as con
+    monkeypatch.setattr(con, "LOCK_REGISTRY", (
+        con.LockSpec("slate_tpu/a.py", None, "_LA", ("_SA",)),
+        con.LockSpec("slate_tpu/b.py", None, "_LB", ("_SB",)),
+    ))
+    root = mini_repo(tmp_path, {
+        "slate_tpu/a.py": """\
+            import threading
+            from . import b
+
+            _LA = threading.Lock()
+            _SA = []
+
+
+            def cross():
+                with _LA:
+                    b.take_b()
+            """,
+        "slate_tpu/b.py": """\
+            import threading
+
+            _LB = threading.Lock()
+            _SB = []
+
+
+            def take_b():
+                with _LB:
+                    _SB.append(1)
+            """,
+    })
+    assert lint(root, {"CON002"}) == []
+
+
+def test_con003_fires_on_compile_under_lock(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/cache.py": """\
+            import threading
+
+            import jax
+
+
+            class ExecutableCache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._exes = {}
+
+                def get(self, key, fn, spec):
+                    with self._lock:
+                        exe = self._exes.get(key)
+                        if exe is None:
+                            exe = jax.jit(fn).lower(spec)
+                            self._exes[key] = exe
+                    return exe
+            """,
+    })
+    fs = lint(root, {"CON003"})
+    assert [f.rule for f in fs] == ["CON003"]
+    assert "lower" in fs[0].message
+
+
+def test_con003_silent_on_compile_outside_lock(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/cache.py": """\
+            import threading
+
+            import jax
+
+
+            class ExecutableCache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._exes = {}
+
+                def get(self, key, fn, spec):
+                    with self._lock:
+                        exe = self._exes.get(key)
+                    if exe is not None:
+                        return exe
+                    exe = jax.jit(fn).lower(spec)
+                    with self._lock:
+                        return self._exes.setdefault(key, exe)
+            """,
+    })
+    assert lint(root, {"CON003"}) == []
+
+
+# --------------------------------------------------------------------------
+# CLI: findings cache, --changed-only, --output artifact
+
+
+CACHE_MINI = {
+    "slate_tpu/mod.py": (
+        "import jax\nimport jax.numpy as jnp\n\n\n"
+        "@jax.jit\ndef entry(x):\n"
+        "    if jnp.sum(x) > 0:\n"
+        "        return x\n"
+        "    return -x\n"),
+}
+
+
+def _trc_findings(report):
+    """A mini repo also fires the SEAM layout rules (it has none of the
+    expected modules); the cache tests key on the TRC001 finding only."""
+    return [f for f in report["findings"] if f["rule"] == "TRC001"]
+
+
+def test_findings_cache_replays_and_invalidates(tmp_path, capsys):
+    root = mini_repo(tmp_path, CACHE_MINI)
+    cache = tmp_path / "cache.json"
+    out = tmp_path / "report.json"
+    base = ["--root", str(root), "--cache", str(cache),
+            "--output", str(out)]
+    assert cli.main(base) == 1
+    cold = json.loads(out.read_text())
+    assert cold["cached"] is False and len(_trc_findings(cold)) == 1
+    assert cli.main(base) == 1                       # warm: replayed
+    warm = json.loads(out.read_text())
+    assert warm["cached"] is True
+    assert warm["findings"] == cold["findings"]
+    # ANY file drift invalidates the whole cache (interprocedural safety)
+    (root / "slate_tpu/mod.py").write_text(
+        CACHE_MINI["slate_tpu/mod.py"].replace("jnp.sum(x) > 0",
+                                               "x.ndim > 0"))
+    assert cli.main(base) == 1      # SEAM layout findings remain
+    fresh = json.loads(out.read_text())
+    assert fresh["cached"] is False and _trc_findings(fresh) == []
+    capsys.readouterr()
+
+
+def test_findings_cache_select_runs_bypass(tmp_path, capsys):
+    """--select subsets must never write or read the full-run cache."""
+    root = mini_repo(tmp_path, CACHE_MINI)
+    cache = tmp_path / "cache.json"
+    assert cli.main(["--root", str(root), "--cache", str(cache),
+                     "--select", "COL001"]) == 0
+    assert not cache.exists()
+    capsys.readouterr()
+
+
+def test_findings_cache_wall_time_budget(tmp_path, capsys):
+    """The tier-1 budget: a warm full repo run replays from the cache in
+    a fraction of the cold analysis time."""
+    import time
+    cache = tmp_path / "cache.json"
+    t0 = time.perf_counter()
+    assert cli.main(["--root", str(REPO), "--cache", str(cache)]) == 0
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    assert cli.main(["--root", str(REPO), "--cache", str(cache)]) == 0
+    warm = time.perf_counter() - t0
+    capsys.readouterr()
+    assert warm < max(2.5, 0.7 * cold)
+
+
+def test_changed_only_filters_to_git_diff(tmp_path, capsys):
+    import shutil
+    import subprocess
+    if shutil.which("git") is None:
+        pytest.skip("git unavailable")
+    root = mini_repo(tmp_path, {
+        **CACHE_MINI,
+        "slate_tpu/clean.py": "def ok():\n    return 1\n",
+    })
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "HOME": str(tmp_path), "PATH": __import__("os").environ["PATH"]}
+    for cmd in (["git", "init", "-q"], ["git", "add", "-A"],
+                ["git", "commit", "-qm", "seed"]):
+        subprocess.run(cmd, cwd=root, env=env, check=True)
+    # committed violation, no changes: --changed-only hides it, exit 0
+    assert cli.main(["--root", str(root), "--changed-only"]) == 0
+    # touch the offending file: the finding is in the changed set again
+    p = root / "slate_tpu/mod.py"
+    p.write_text(p.read_text() + "\n")
+    assert cli.main(["--root", str(root), "--changed-only"]) == 1
+    # a full run still reports it regardless of git state
+    assert cli.main(["--root", str(root)]) == 1
+    capsys.readouterr()
+
+
+def test_changed_only_falls_back_without_git(tmp_path, capsys):
+    """No git repo: --changed-only degrades to reporting everything
+    rather than silently hiding findings."""
+    root = mini_repo(tmp_path, CACHE_MINI)
+    assert cli.main(["--root", str(root), "--changed-only"]) == 1
+    out = capsys.readouterr()
+    assert "git unavailable" in out.err
+
+
+def test_output_artifact_schema(tmp_path, capsys):
+    root = mini_repo(tmp_path, CACHE_MINI)
+    out = tmp_path / "report.json"
+    assert cli.main(["--root", str(root), "--output", str(out)]) == 1
+    report = json.loads(out.read_text())
+    assert set(report) == {"findings", "baselined", "stale_baseline",
+                           "rules", "files", "changed_only", "cached"}
+    assert report["rules"] == sorted(REGISTRY)
+    assert report["files"] == 1
+    assert [f["rule"] for f in _trc_findings(report)] == ["TRC001"]
+    capsys.readouterr()

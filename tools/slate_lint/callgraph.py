@@ -1,0 +1,158 @@
+"""Cross-module call graph: the shared spine of the interprocedural passes.
+
+The reachability pass (reachability.py) resolves *lexical* edges — direct
+calls, bare references, nested defs.  This module adds the two edge kinds
+that used to be documented false negatives (docs/STATIC_ANALYSIS.md) and
+packages everything as one queryable graph:
+
+- **dict-dispatch tables** — module-level ``NAME = {"k": fn, ...}`` maps
+  of resolvable functions (the ``serve.CORES`` idiom).  A call through a
+  table (``CORES[op](...)``, or the two-step ``core = CORES[op];
+  core(...)`` alias, or a traced lambda closing over such an alias) may
+  reach ANY value of the table, so every value becomes an edge.
+- **re-exports** — ``pkg.fn`` where ``pkg/__init__.py`` (or any
+  intermediate module) merely imports ``fn`` from a submodule.  Dotted
+  resolution follows the import map of the resolved module recursively
+  (cycle-guarded) until it lands on a real ``def``.
+
+Dispatch-table collection lives here; re-export following is implemented
+inside ``Reachability._resolve_dotted`` (it IS dotted resolution) and
+documented here because this module is the call-graph surface.
+
+:class:`CallGraph` is the facade the concurrency pass builds on: forward
+(``callees``) and reverse (``callers``) edges over every indexed module
+function, plus a separate index of CLASS METHODS (``<rel>::<Class>.<m>``)
+— reachability deliberately does not model methods (jax entries are
+functions), but lock-discipline analysis must see ``self.helper()``
+chains inside ``Server`` / ``ExecutableCache``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .loader import Project, SourceModule
+
+
+def collect_dispatch_tables(reach) -> dict[str, dict[str, tuple[str, ...]]]:
+    """``rel -> {table_name: (function keys...)}`` for module-level
+    dict-dispatch tables.  A table is recorded when at least one value
+    resolves to a project function; unresolvable values (e.g. imported
+    third-party callables) are skipped, keeping the edge set a
+    best-effort under-approximation rather than a guess."""
+    tables: dict[str, dict[str, tuple[str, ...]]] = {}
+    for rel, mod in reach.project.modules.items():
+        per: dict[str, tuple[str, ...]] = {}
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                targets = [t for t in node.targets if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not targets or not isinstance(value, ast.Dict):
+                continue
+            keys: list[str] = []
+            for v in value.values:
+                k = None
+                if isinstance(v, ast.Name):
+                    k = reach.resolve_name(v.id, None, rel)
+                elif isinstance(v, ast.Attribute) and \
+                        isinstance(v.value, ast.Name):
+                    k = reach.resolve_attr(v.value.id, v.attr, rel)
+                if k:
+                    keys.append(k)
+            if keys:
+                for t in targets:
+                    per[t.id] = tuple(dict.fromkeys(keys))
+        if per:
+            tables[rel] = per
+    return tables
+
+
+class MethodInfo:
+    """One class method: enough context for lock-discipline analysis."""
+
+    def __init__(self, key: str, node: ast.FunctionDef,
+                 module: SourceModule, cls: str):
+        self.key = key              # "<rel>::<Class>.<method>"
+        self.node = node
+        self.module = module
+        self.cls = cls
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+def _iter_class_methods(module: SourceModule):
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for sub in node.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node.name, sub
+
+
+class CallGraph:
+    """Forward/reverse edges over module functions and class methods.
+
+    Keys are reachability function keys (``<rel>::<qual>``) plus method
+    keys (``<rel>::<Class>.<method>``).  Edges are the reachability
+    pass's resolved calls/refs (which already include dispatch-table and
+    re-export targets) plus, for methods, ``self.other()`` calls within
+    the same class and lexically-resolvable module-level calls."""
+
+    def __init__(self, project: Project):
+        from . import reachability  # local: reachability imports us too
+        self.reach = reach = reachability.compute(project)
+        self.project = project
+        self.methods: dict[str, MethodInfo] = {}
+        for rel, mod in project.modules.items():
+            for cls, node in _iter_class_methods(mod):
+                mi = MethodInfo(f"{rel}::{cls}.{node.name}", node, mod, cls)
+                self.methods[mi.key] = mi
+        self.nodes: dict[str, object] = {**reach.functions, **self.methods}
+        self.edges: dict[str, set[str]] = {}
+        for key, info in reach.functions.items():
+            self.edges[key] = (set(info.resolved_calls)
+                               | set(info.resolved_refs)
+                               | {c.key for c in info.children.values()})
+        for key, mi in self.methods.items():
+            self.edges[key] = self._method_edges(mi)
+        self.rev: dict[str, set[str]] = {k: set() for k in self.edges}
+        for src, dsts in self.edges.items():
+            for dst in dsts:
+                self.rev.setdefault(dst, set()).add(src)
+
+    def _method_edges(self, mi: MethodInfo) -> set[str]:
+        from .reachability import own_nodes
+        reach, rel = self.reach, mi.module.rel
+        out: set[str] = set()
+        for node in own_nodes(mi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                mkey = f"{rel}::{mi.cls}.{f.attr}"
+                if mkey in self.methods:
+                    out.add(mkey)
+                    continue
+            out.update(reach.resolve_call_targets(node, None, rel))
+        return out
+
+    def callees(self, key: str) -> set[str]:
+        return self.edges.get(key, set())
+
+    def callers(self, key: str) -> set[str]:
+        return self.rev.get(key, set())
+
+
+def compute(project: Project) -> CallGraph:
+    if "callgraph" not in project.cache:
+        project.cache["callgraph"] = CallGraph(project)
+    return project.cache["callgraph"]

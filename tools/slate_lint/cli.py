@@ -5,6 +5,8 @@ Usage::
     python -m tools.slate_lint [--root DIR] [--format human|json]
                                [--select RULES] [--baseline FILE]
                                [--update-baseline] [--list-rules]
+                               [--cache FILE] [--changed-only]
+                               [--output FILE]
 
 Exit codes: 0 clean (no findings outside the baseline), 1 findings,
 2 usage / internal error.
@@ -15,15 +17,27 @@ not grow.  ``--update-baseline`` rewrites it from the current findings;
 the checked-in ``tools/slate_lint/baseline.json`` is empty and the repo
 is expected to stay clean (suppress intentional sites inline with a
 reason instead of baselining them).
+
+``--cache FILE`` (or ``SLATE_LINT_CACHE=FILE``) replays a full run
+against an unchanged tree from the per-file content-hash cache
+(fscache.py) — sound because ANY file drift forces full re-analysis.
+``--changed-only`` reports (and gates the exit code on) findings in
+files changed vs git HEAD plus untracked files; the analysis itself
+stays whole-project, so interprocedural findings in changed files are
+still correct.  ``--output FILE`` writes the JSON report to a file in
+every format mode — the tier-1 artifact CI archives.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 from pathlib import Path
 
+from . import fscache
 from .loader import load_project
 from .model import REGISTRY, Finding
 
@@ -48,6 +62,25 @@ def run_rules(project, select: set[str] | None = None) -> list[Finding]:
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings
+
+
+def changed_files(root: Path) -> set[str] | None:
+    """Repo-relative paths changed vs HEAD plus untracked files, or None
+    when git is unavailable (no repo, no binary) — callers fall back to
+    reporting everything rather than silently hiding findings."""
+    out: set[str] = set()
+    for cmd in (("diff", "--name-only", "HEAD"),
+                ("ls-files", "--others", "--exclude-standard")):
+        try:
+            res = subprocess.run(["git", "-C", str(root), *cmd],
+                                 capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if res.returncode != 0:
+            return None
+        out.update(line.strip() for line in res.stdout.splitlines()
+                   if line.strip())
+    return out
 
 
 def read_baseline(path: Path) -> list[tuple[str, str, str]]:
@@ -93,6 +126,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="rewrite the baseline from current findings and "
                          "exit 0")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--cache", default=None,
+                    help="findings cache file (default: $SLATE_LINT_CACHE; "
+                         "unset disables).  Full runs against an unchanged "
+                         "tree replay from it instead of re-analyzing")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files changed vs git "
+                         "HEAD (plus untracked); analysis stays "
+                         "whole-project")
+    ap.add_argument("--output", default=None,
+                    help="also write the JSON report to this file "
+                         "(CI artifact), regardless of --format")
     args = ap.parse_args(argv)
 
     registry = load_rules()
@@ -113,7 +157,20 @@ def main(argv: list[str] | None = None) -> int:
             return 2
 
     project = load_project(root)
-    findings = run_rules(project, select)
+
+    # full-run findings cache: sound to replay only when select is None
+    # (the cached list IS the full surface) and every file hash matches
+    cache_arg = args.cache or os.environ.get("SLATE_LINT_CACHE") or None
+    cache_path = Path(cache_arg) if cache_arg else None
+    full_run = select is None and not args.update_baseline
+    findings = None
+    if cache_path is not None and full_run:
+        findings = fscache.load(cache_path, project, registry.keys())
+    cached = findings is not None
+    if findings is None:
+        findings = run_rules(project, select)
+        if cache_path is not None and full_run:
+            fscache.store(cache_path, project, registry.keys(), findings)
 
     baseline_path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
     if args.update_baseline:
@@ -126,22 +183,42 @@ def main(argv: list[str] | None = None) -> int:
     baseline = read_baseline(baseline_path)
     new, stale = apply_baseline(findings, baseline)
 
-    if args.format == "json":
-        print(json.dumps({
-            "findings": [f.to_json() for f in new],
-            "baselined": len(findings) - len(new),
-            "stale_baseline": [list(fp) for fp in stale],
-        }, indent=1))
-        return 1 if new else 0
+    shown = new
+    if args.changed_only:
+        changed = changed_files(root)
+        if changed is None:
+            print("slate-lint: --changed-only: git unavailable, "
+                  "reporting all findings", file=sys.stderr)
+        else:
+            shown = [f for f in new if f.path in changed]
 
-    for f in new:
+    report = {
+        "findings": [f.to_json() for f in shown],
+        "baselined": len(findings) - len(new),
+        "stale_baseline": [list(fp) for fp in stale],
+        "rules": sorted(registry if select is None else select),
+        "files": len(project.modules),
+        "changed_only": bool(args.changed_only),
+        "cached": cached,
+    }
+    if args.output:
+        Path(args.output).write_text(json.dumps(report, indent=1) + "\n")
+
+    if args.format == "json":
+        print(json.dumps(report, indent=1))
+        return 1 if shown else 0
+
+    for f in shown:
         print(f.render())
     if stale:
         print(f"note: {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'} no longer fire "
               f"(run --update-baseline)", file=sys.stderr)
-    if new:
-        print(f"\nslate-lint: {len(new)} finding(s) "
+    if args.changed_only and len(shown) != len(new):
+        print(f"note: {len(new) - len(shown)} finding(s) outside the "
+              f"changed file set not shown", file=sys.stderr)
+    if shown:
+        print(f"\nslate-lint: {len(shown)} finding(s) "
               f"({len(findings) - len(new)} baselined)", file=sys.stderr)
         return 1
     print(f"slate-lint OK: {len(registry) if select is None else len(select)}"

@@ -1,4 +1,12 @@
-"""Intraprocedural taint: which local names hold TRACED array values.
+"""Taint analysis: which local names hold TRACED array values.
+
+The per-function analysis (:class:`TaintAnalysis`) is intraprocedural;
+:func:`taints` lifts it to the whole traced set by propagating tainted
+CALL-SITE ARGUMENTS to callee parameters across the call graph —
+including the dict-dispatch and re-export edges callgraph.py resolves —
+to a fixpoint.  A transitively-traced module-level function whose array
+parameter carries no annotation is still seeded when any traced caller
+feeds it a tainted value.
 
 Seeds
 -----
@@ -30,6 +38,7 @@ from __future__ import annotations
 
 import ast
 
+from . import reachability
 from .reachability import FuncInfo, own_nodes
 
 #: module aliases whose attribute calls produce traced arrays
@@ -46,8 +55,18 @@ STATIC_RESULT_BUILTINS = {
     "len", "isinstance", "issubclass", "getattr", "hasattr", "type",
     "range", "enumerate", "callable", "id", "repr", "str",
 }
-#: attribute reads on a tracer that are static at trace time
-STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "sharding"}
+#: attribute reads on a tracer that are static at trace time.  Beyond
+#: the jax array surface, this includes the Matrix/TileStorage wrapper
+#: metadata (core/matrix.py): those pytrees carry traced tile DATA in
+#: ``.storage``/``.tiles``/``.data`` but their dims, tile sizes, grid and
+#: view flags are __init__-time host ints/enums — branching on them is
+#: the repo's standard trace-time dispatch.
+STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "sharding",
+                "m", "n", "mt", "nt", "Mt", "Nt", "mb", "nb", "io", "jo",
+                "grid", "op", "kind", "uplo", "diag", "source"}
+#: method calls on a wrapper that return host metadata, never tracers
+STATIC_METHODS = {"is_root_view", "is_traced", "tile_mb", "tile_nb",
+                  "tile_rank"}
 #: python builtins that force concretization of their argument
 CONCRETIZERS = {"bool", "float", "int", "complex"}
 #: method calls that force concretization of their receiver
@@ -80,12 +99,19 @@ class TaintAnalysis:
 
     def __init__(self, info: FuncInfo, ns_aliases: set[str],
                  direct_fns: set[str], taint_all_params: bool,
-                 inherited: frozenset[str] = frozenset()):
+                 inherited: frozenset[str] = frozenset(),
+                 extra_seeds: frozenset[str] = frozenset(),
+                 summary=None):
         self.info = info
         self.ns = ns_aliases          # jnp/lax-style module aliases
         self.direct_fns = direct_fns  # names imported straight from jnp/lax
+        #: optional interprocedural return-taint oracle:
+        #: call -> bool | [bool per tuple element] | None (unknown)
+        self.summary = summary
         self.tainted: set[str] = set(inherited)
         self._seed_params(taint_all_params)
+        # interprocedural seeds: params fed tainted values at a call site
+        self.tainted.update(extra_seeds)
         self._fixpoint()
 
     # ---- seeding ------------------------------------------------------
@@ -164,6 +190,13 @@ class TaintAnalysis:
             return True
         if isinstance(f, ast.Attribute) and f.attr in STATIC_JNP_FNS:
             return False
+        if isinstance(f, ast.Attribute) and f.attr in STATIC_METHODS:
+            return False  # host-metadata method on a wrapper/HealthInfo
+        if self.summary is not None:
+            known = self.summary(call)
+            if known is not None:
+                return (any(known) if isinstance(known, list) else
+                        bool(known))
         if isinstance(f, ast.Name):
             if f.id in CONCRETIZERS:  # host scalar out (and a sink)
                 return False
@@ -193,12 +226,33 @@ class TaintAnalysis:
             self._assign_targets(target.value)
         # attribute/subscript stores don't create locals
 
+    def _destructured_call(self, node: ast.Assign) -> bool:
+        """``a, b = helper(...)`` with an element-wise return summary:
+        taint each target from the matching return-tuple element instead
+        of the whole-call verdict (``ad, n0 = _pad_tri(ad, nb)`` leaves
+        the static ``n0`` clean).  True when handled."""
+        if self.summary is None or not isinstance(node.value, ast.Call):
+            return False
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Tuple):
+            return False
+        elts = node.targets[0].elts
+        known = self.summary(node.value)
+        if not isinstance(known, list) or len(known) != len(elts):
+            return False
+        for elt, hot in zip(elts, known):
+            if hot:
+                self._assign_targets(elt)
+        return True
+
     def _fixpoint(self):
         changed = True
         while changed:
             before = len(self.tainted)
             for node in own_nodes(self.info.node):
                 if isinstance(node, ast.Assign):
+                    if self._destructured_call(node):
+                        continue
                     if self.expr_tainted(node.value):
                         for t in node.targets:
                             self._assign_targets(t)
@@ -225,11 +279,208 @@ class TaintAnalysis:
 
 def analyze(info: FuncInfo, imports: dict[str, str],
             taint_all_params: bool,
-            inherited: frozenset[str] = frozenset()) -> TaintAnalysis:
+            inherited: frozenset[str] = frozenset(),
+            extra_seeds: frozenset[str] = frozenset(),
+            summary=None) -> TaintAnalysis:
     ns = array_namespace_aliases(imports)
     direct = {name for name, dotted in imports.items()
               if any(dotted == f"{m}.{name.split('.')[-1]}" or
                      dotted.startswith(f"{m}.")
                      for m in ("jax.numpy", "jax.lax"))
               and dotted.rsplit(".", 1)[-1] not in STATIC_JNP_FNS}
-    return TaintAnalysis(info, ns, direct, taint_all_params, inherited)
+    return TaintAnalysis(info, ns, direct, taint_all_params, inherited,
+                         extra_seeds, summary)
+
+
+# ---- interprocedural lifting ---------------------------------------------
+
+#: modules whose functions never receive interprocedural taint seeds:
+#: the host-only obs layer (jaxpr-identity contract — every tracer it is
+#: handed is guarded by ``is_traced()`` checks and recorded as None) and
+#: the registered eager policy seams, whose tracer handling is the
+#: designed trace-time behaviour (guarded raises, config resolution).
+TAINT_BARRIER_MODULES = {
+    "slate_tpu/obs/events.py",
+    "slate_tpu/obs/flops.py",
+    "slate_tpu/obs/sentinel.py",
+    "slate_tpu/robust/health.py",
+    "slate_tpu/robust/recovery.py",
+    "slate_tpu/exceptions.py",
+    "slate_tpu/options.py",
+}
+
+#: cap on reanalyses of one function during the interprocedural fixpoint
+#: — return summaries can refine non-monotonically, so a hard bound
+#: guarantees termination (never reached on the repo; pure safety net)
+_MAX_REBUILDS = 8
+
+
+def _seedable_params(callee: FuncInfo) -> list[str | None]:
+    """Positional parameter slots open to interprocedural seeding: a
+    parameter annotated with a NON-array type (``opts: Options``,
+    ``n: int``) declares itself host config and is never seeded; array
+    annotations and bare parameters are eligible."""
+    out: list[str | None] = []
+    for arg in callee.params():
+        ann = _ann_text(arg.annotation)
+        eligible = not ann or any(a in ann for a in ARRAY_ANNOTATIONS)
+        out.append(arg.arg if eligible else None)
+    return out
+
+
+def _args_to_params(ta: TaintAnalysis, call: ast.Call,
+                    callee: FuncInfo) -> set[str]:
+    """Seedable callee parameter names bound to TAINTED arguments."""
+    names = _seedable_params(callee)
+    out: set[str] = set()
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            if ta.expr_tainted(arg.value):
+                out.update(n for n in names[i:] if n)
+        elif i < len(names) and names[i] and ta.expr_tainted(arg):
+            out.add(names[i])
+    for kw in call.keywords:
+        if kw.arg is None:  # **kwargs: positions unknowable
+            if ta.expr_tainted(kw.value):
+                out.update(n for n in names if n)
+        elif kw.arg in {n for n in names if n} and ta.expr_tainted(kw.value):
+            out.add(kw.arg)
+    return out
+
+
+def taints(project) -> tuple:
+    """``(reach, {key: TaintAnalysis})`` for every traced function.
+
+    Built parents-before-children so closures inherit the enclosing
+    function's tainted names, then driven to an interprocedural fixpoint
+    over the call graph (dispatch-table and re-export edges included):
+
+    - tainted call-site ARGUMENTS seed the receiving callee parameters
+      (unless the callee's annotation declares host config, the callee
+      is a taint-barrier module, or the seeding policy already taints
+      everything), and the callee is reanalyzed;
+    - callee RETURN taint flows back: each analysis consults an oracle
+      mapping a resolvable call to its callee's return-expression taint,
+      element-wise for tuple returns, so ``ad, n0 = _pad_tri(ad, nb)``
+      taints ``ad`` but leaves the shape-derived ``n0`` clean.
+
+    Reanalysis is capped per function (:data:`_MAX_REBUILDS`) so the
+    refinement loop terminates even on adversarial cycles.  Cached on
+    the project (``cache['taints']``)."""
+    if "taints" in project.cache:
+        return project.cache["taints"]
+    reach = reachability.compute(project)
+    memo: dict[str, TaintAnalysis] = {}
+    extra: dict[str, set[str]] = {}
+    callers: dict[str, set[str]] = {}
+    rebuilds: dict[str, int] = {}
+
+    def summary_for(info: FuncInfo):
+        rel = info.module.rel
+
+        def oracle(call: ast.Call):
+            targets = reach.resolve_call_targets(call, info, rel)
+            if len(targets) != 1:
+                return None
+            (tkey,) = targets
+            ta = memo.get(tkey)
+            if ta is None:
+                return None
+            rets = [n for n in own_nodes(ta.info.node)
+                    if isinstance(n, ast.Return)]
+            if not rets:
+                return False
+            shapes: list[list[bool] | bool] = []
+            for r in rets:
+                if isinstance(r.value, ast.Tuple):
+                    shapes.append([ta.expr_tainted(e)
+                                   for e in r.value.elts])
+                else:
+                    shapes.append(ta.expr_tainted(r.value))
+            first = shapes[0]
+            if all(isinstance(s, list) and isinstance(first, list)
+                   and len(s) == len(first) for s in shapes):
+                return [any(s[i] for s in shapes)
+                        for i in range(len(first))]
+            return any(any(s) if isinstance(s, list) else s
+                       for s in shapes)
+
+        return oracle
+
+    def build(key: str) -> TaintAnalysis:
+        info = reach.functions[key]
+        inherited = frozenset()
+        if info.parent is not None and info.parent.key in memo:
+            inherited = frozenset(memo[info.parent.key].tainted)
+        memo[key] = analyze(
+            info, reach.imports[info.module.rel],
+            reach.taint_all_params(info), inherited,
+            frozenset(extra.get(key, ())), summary_for(info))
+        return memo[key]
+
+    def get(key: str) -> TaintAnalysis:
+        if key in memo:
+            return memo[key]
+        info = reach.functions[key]
+        if info.parent is not None and info.parent.key in reach.traced:
+            get(info.parent.key)
+        return build(key)
+
+    for key in sorted(reach.traced):
+        if key in reach.functions:
+            get(key)
+    # second pass: the first build of a function that sorts BEFORE its
+    # callees ran with a cold oracle (whole-call fallback).  Now that
+    # every function is in the memo, rebuild each once so return-taint
+    # summaries apply everywhere (parents sort before their children, so
+    # closure inheritance stays consistent).
+    for key in sorted(memo):
+        build(key)
+
+    def rebuild(key: str, worklist: list[str]):
+        if rebuilds.get(key, 0) >= _MAX_REBUILDS:
+            return
+        rebuilds[key] = rebuilds.get(key, 0) + 1
+        before = set(memo[key].tainted)
+        build(key)
+        worklist.append(key)
+        if memo[key].tainted != before:
+            # return summary changed: callers must re-ANALYZE (a bare
+            # worklist append would only rescan their call sites against
+            # the stale analysis)
+            for c in callers.get(key, ()):
+                rebuild(c, worklist)
+        for child in reach.functions[key].children.values():
+            if child.key in memo:
+                rebuild(child.key, worklist)
+
+    worklist = sorted(memo)
+    seen_pass = set()
+    while worklist:
+        key = worklist.pop()
+        ta = memo[key]
+        info = reach.functions[key]
+        rel = info.module.rel
+        first_visit = key not in seen_pass
+        seen_pass.add(key)
+        for node in own_nodes(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            for tkey in reach.resolve_call_targets(node, info, rel):
+                if tkey not in memo:
+                    continue
+                callee = reach.functions[tkey]
+                if first_visit:
+                    callers.setdefault(tkey, set()).add(key)
+                if reach.taint_all_params(callee):
+                    continue  # policy already taints every parameter
+                if callee.module.rel in TAINT_BARRIER_MODULES:
+                    continue  # host-only / eager-seam boundary
+                new = (_args_to_params(ta, node, callee)
+                       - callee.static_params - memo[tkey].tainted)
+                if new:
+                    extra.setdefault(tkey, set()).update(new)
+                    rebuild(tkey, worklist)
+
+    project.cache["taints"] = (reach, memo)
+    return project.cache["taints"]

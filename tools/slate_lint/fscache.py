@@ -1,0 +1,67 @@
+"""Findings cache keyed on per-file content hashes.
+
+The expensive part of a lint run is the interprocedural analysis
+(reachability + call graph + taint fixpoint), and its result for ONE
+file can change when ANOTHER file changes — a callee's return taint, a
+dispatch table, an ``__init__.py`` re-export, a lock-registry edit.  A
+per-file *replay* would therefore be unsound.  The cache instead stores
+the sha256 of every scanned file plus the rule surface, and replays the
+complete findings list only when EVERY hash matches and the file set
+and rule set are identical.  Any drift at all means a full re-analysis
+(which then refreshes the cache).  This is exactly the CI shape: the
+common re-run against an unchanged tree is O(hashing) instead of
+O(analysis), and no correctness is traded for it.
+
+Only full runs are cached: ``--select`` subsets and baseline updates
+bypass the cache entirely (their findings lists are not the full
+surface and must never be replayed as if they were).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from .loader import Project
+from .model import Finding
+
+SCHEMA = 1
+
+
+def file_digests(project: Project) -> dict[str, str]:
+    """``rel -> sha256(content)`` for every scanned module."""
+    return {rel: hashlib.sha256(m.text.encode("utf-8")).hexdigest()
+            for rel, m in sorted(project.modules.items())}
+
+
+def cache_key(project: Project, rule_ids) -> dict:
+    return {"schema": SCHEMA, "rules": sorted(rule_ids),
+            "files": file_digests(project)}
+
+
+def load(path: Path, project: Project, rule_ids) -> list[Finding] | None:
+    """The cached full-run findings, or None on any mismatch/corruption."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or \
+            data.get("key") != cache_key(project, rule_ids):
+        return None
+    try:
+        return [Finding(f["rule"], f["path"], int(f["line"]), f["message"])
+                for f in data["findings"]]
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def store(path: Path, project: Project, rule_ids,
+          findings: list[Finding]) -> None:
+    """Best-effort write; an unwritable cache never fails the run."""
+    payload = {"key": cache_key(project, rule_ids),
+               "findings": [f.to_json() for f in findings]}
+    try:
+        Path(path).write_text(json.dumps(payload, indent=1) + "\n")
+    except OSError:
+        pass

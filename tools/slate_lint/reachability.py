@@ -23,10 +23,15 @@ A function is traced when jax traces it rather than running it eagerly:
 Resolution is lexical and best-effort: a ``Name`` resolves through the
 enclosing-function chain, then module-level ``def``\\ s, then the module's
 import map (``from ..internal import gemm`` makes ``gemm.fn`` resolvable).
-Known false-negative edges — dynamic dispatch through dicts of functions
-built at runtime, ``getattr``, re-exports through ``__init__`` — are
-documented in docs/STATIC_ANALYSIS.md; the repo's kernel layers are
-written in the resolvable style.
+Two formerly-documented false-negative edges are now resolved through the
+call-graph layer (callgraph.py): re-exports (``serve.solve_core`` where
+``serve/__init__.py`` imports it from ``batched``) follow import maps
+recursively, and module-level dict-dispatch tables (``serve.CORES``)
+contribute every table value as a possible callee — both at direct call
+sites (``CORES[op](...)``) and through local aliases
+(``core = CORES[op]; core(...)``, including traced-lambda closures).
+Remaining false-negative edges (``getattr``, tables built at runtime)
+are documented in docs/STATIC_ANALYSIS.md.
 
 Entries created with ``jax.jit(lambda ...: f(...))`` contribute their
 lambda body's resolvable callees as traced roots (the lambda itself is
@@ -36,6 +41,7 @@ not modelled as a function).
 from __future__ import annotations
 
 import ast
+from . import callgraph as _cg
 from .loader import Project, SourceModule
 
 #: wrappers whose first callable argument becomes a traced entry
@@ -130,8 +136,13 @@ class Reachability:
         self.module_funcs: dict[str, dict[str, str]] = {}  # rel -> name->key
         self.imports: dict[str, dict[str, str]] = {}       # rel -> name->dotted
         self.entries: set[str] = set()
+        self.entry_kinds: dict[str, set[str]] = {}  # key -> wrapper names
         self.traced: set[str] = set()
+        self._alias_memo: dict[str, dict[str, tuple[str, ...]]] = {}
         self._index()
+        # rel -> {NAME: (fn keys)} module-level dict-dispatch tables; needs
+        # the function index, feeds call-site resolution below
+        self.dispatch_tables = _cg.collect_dispatch_tables(self)
         self._resolve_and_find_entries()
         self._closure()
 
@@ -183,15 +194,31 @@ class Reachability:
             return self._resolve_dotted(f"{dotted}.{attr}")
         return None
 
-    def _resolve_dotted(self, dotted: str) -> str | None:
-        """``pkg.mod.fn`` -> key, when pkg.mod is a project module."""
+    def _resolve_dotted(self, dotted: str,
+                        _seen: set[str] | None = None) -> str | None:
+        """``pkg.mod.fn`` -> key, when pkg.mod is a project module.
+
+        When the named module does not DEFINE the function, its import
+        map is followed recursively: ``serve.solve_core`` resolves even
+        though ``serve/__init__.py`` only re-exports it from
+        ``serve.batched`` (the re-export edge callgraph.py documents).
+        Cycle-guarded; intermediate-module aliasing chains resolve too."""
         if dotted in self.project.by_dotted:  # a module, not a function
             return None
         mod_name, _, fn_name = dotted.rpartition(".")
         mod = self.project.by_dotted.get(mod_name)
         if mod is None:
             return None
-        return self.module_funcs.get(mod.rel, {}).get(fn_name)
+        key = self.module_funcs.get(mod.rel, {}).get(fn_name)
+        if key is not None:
+            return key
+        fwd = self.imports.get(mod.rel, {}).get(fn_name)
+        if fwd and fwd != dotted:
+            seen = _seen if _seen is not None else set()
+            if dotted not in seen:
+                seen.add(dotted)
+                return self._resolve_dotted(fwd, seen)
+        return None
 
     def resolve_call_target(self, call: ast.Call, scope: FuncInfo | None,
                             rel: str) -> str | None:
@@ -201,6 +228,86 @@ class Reachability:
         if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
             return self.resolve_attr(f.value.id, f.attr, rel)
         return None
+
+    # ---- dict-dispatch resolution ------------------------------------
+
+    def dispatch_table(self, expr: ast.AST, scope: FuncInfo | None,
+                       rel: str) -> tuple[str, ...] | None:
+        """Function keys of the dispatch table ``expr`` names, if any:
+        a module-level table in this module, ``mod.TABLE`` through the
+        import map, or a re-exported table through ``__init__``."""
+        if isinstance(expr, ast.Name):
+            tab = self.dispatch_tables.get(rel, {}).get(expr.id)
+            if tab:
+                return tab
+            dotted = self.imports.get(rel, {}).get(expr.id)
+            if dotted:
+                return self._dotted_table(dotted)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            dotted = self.imports.get(rel, {}).get(expr.value.id)
+            if dotted:
+                return self._dotted_table(f"{dotted}.{expr.attr}")
+        return None
+
+    def _dotted_table(self, dotted: str,
+                      _seen: set[str] | None = None
+                      ) -> tuple[str, ...] | None:
+        mod_name, _, name = dotted.rpartition(".")
+        mod = self.project.by_dotted.get(mod_name)
+        if mod is None:
+            return None
+        tab = self.dispatch_tables.get(mod.rel, {}).get(name)
+        if tab:
+            return tab
+        fwd = self.imports.get(mod.rel, {}).get(name)
+        if fwd and fwd != dotted:
+            seen = _seen if _seen is not None else set()
+            if dotted not in seen:
+                seen.add(dotted)
+                return self._dotted_table(fwd, seen)
+        return None
+
+    def _dispatch_aliases(self, scope: FuncInfo | None
+                          ) -> dict[str, tuple[str, ...]]:
+        """Local name -> table keys for ``core = CORES[op]``-style
+        assignments in the enclosing-function chain (memoized)."""
+        if scope is None:
+            return {}
+        cached = self._alias_memo.get(scope.key)
+        if cached is None:
+            cached = dict(self._dispatch_aliases(scope.parent))
+            rel = scope.module.rel
+            for node in own_nodes(scope.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Subscript):
+                    tab = self.dispatch_table(node.value.value, scope, rel)
+                    if tab:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                cached[t.id] = tab
+            self._alias_memo[scope.key] = cached
+        return cached
+
+    def resolve_call_targets(self, call: ast.Call, scope: FuncInfo | None,
+                             rel: str) -> set[str]:
+        """Every function key a call may reach: the single lexical
+        target plus dict-dispatch edges (``CORES[op](...)`` and the
+        ``core = CORES[op]; core(...)`` alias form)."""
+        out: set[str] = set()
+        single = self.resolve_call_target(call, scope, rel)
+        if single:
+            out.add(single)
+        f = call.func
+        if isinstance(f, ast.Subscript):
+            tab = self.dispatch_table(f.value, scope, rel)
+            if tab:
+                out.update(tab)
+        elif isinstance(f, ast.Name) and single is None:
+            tab = self._dispatch_aliases(scope).get(f.id)
+            if tab:
+                out.update(tab)
+        return out
 
     # ---- entry discovery ---------------------------------------------
 
@@ -223,11 +330,14 @@ class Reachability:
                         out.add(c.value)
         return out
 
-    def _mark_entry(self, key: str | None, static: set[str] = frozenset()):
+    def _mark_entry(self, key: str | None, static: set[str] = frozenset(),
+                    kind: str = "jit"):
         """Mark ``key`` as a traced entry.  ``static`` is the set of its
         parameters that are trace-time-static AT THIS ENTRY SITE; a
         parameter is recorded static only if it is static at EVERY site
-        (intersection), since any one traced binding makes it traced."""
+        (intersection), since any one traced binding makes it traced.
+        ``kind`` records the wrapper (``entry_kinds``) so the collective-
+        sequence pass can pick out mesh entries (shard_map*)."""
         if key is None:
             return
         info = self.functions[key]
@@ -237,6 +347,7 @@ class Reachability:
             info.is_entry = True
             info.static_params = set(static)
         self.entries.add(key)
+        self.entry_kinds.setdefault(key, set()).add(kind)
 
     def _resolve_and_find_entries(self):
         for key, info in self.functions.items():
@@ -259,9 +370,8 @@ class Reachability:
             # body: calls, references, wrapper args
             for node in own_nodes(info.node):
                 if isinstance(node, ast.Call):
-                    target = self.resolve_call_target(node, info, rel)
-                    if target:
-                        info.resolved_calls.add(target)
+                    info.resolved_calls.update(
+                        self.resolve_call_targets(node, info, rel))
                     wname = self._callable_name(node.func)
                     if wname in ENTRY_WRAPPERS and node.args:
                         self._wrapper_entry(node, info, rel, wname)
@@ -322,8 +432,15 @@ class Reachability:
         target = call.args[0]
         if isinstance(target, ast.Name):
             key = self.resolve_name(target.id, scope, rel)
+            if key is None:
+                # jax.vmap(core) where ``core = CORES[op]``: every table
+                # value is a possible entry, all params traced
+                for tkey in self._dispatch_aliases(scope).get(target.id, ()):
+                    self._mark_entry(tkey, kind=wname)
+                return
             self._mark_entry(key,
-                             static | self._prefetch_params(key, prefetch))
+                             static | self._prefetch_params(key, prefetch),
+                             kind=wname)
         elif (isinstance(target, ast.Call)
               and self._callable_name(target.func) == "partial"
               and target.args and isinstance(target.args[0], ast.Name)):
@@ -334,9 +451,12 @@ class Reachability:
             self._mark_entry(
                 key,
                 {kw.arg for kw in target.keywords if kw.arg is not None}
-                | self._prefetch_params(key, prefetch))
+                | self._prefetch_params(key, prefetch),
+                kind=wname)
         elif isinstance(target, ast.Lambda):
-            # the lambda body is traced: its resolvable callees are roots.
+            # the lambda body is traced: its resolvable callees are roots
+            # (including dict-dispatch aliases — the serving layer's
+            # ``vmap(lambda ai, bi: core(ai, bi, opts))`` idiom).
             # Only arguments fed from the LAMBDA'S OWN parameters are
             # traced; closure-bound arguments (``Nt=Nt``, ``lower=lower``
             # — the shard_map static-config idiom) are trace-time-static.
@@ -345,10 +465,10 @@ class Reachability:
                                           *target.args.kwonlyargs)}
             for node in ast.walk(target.body):
                 if isinstance(node, ast.Call):
-                    key = self.resolve_call_target(node, scope, rel)
-                    if key:
+                    for key in self.resolve_call_targets(node, scope, rel):
                         self._mark_entry(
-                            key, self._lambda_statics(node, key, lam_params))
+                            key, self._lambda_statics(node, key, lam_params),
+                            kind=wname)
 
     def _lambda_statics(self, call: ast.Call, key: str,
                         lam_params: set[str]) -> set[str]:

@@ -1,5 +1,5 @@
 """Rule packs.  Importing this package populates the registry."""
 
-from . import collectives, obs, seams, trace_safety  # noqa: F401
+from . import collectives, concurrency, obs, seams, trace_safety  # noqa: F401
 
 from ..model import REGISTRY  # noqa: F401  (re-export for convenience)

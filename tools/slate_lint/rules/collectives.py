@@ -25,13 +25,40 @@ Rules:
   fault-consumption module (robust/faults.py): host callbacks are
   ordering hazards inside collective programs and are allowed only at
   the audited fault-injection seam.
+
+Collective-sequence abstract interpretation (COL005-COL008): for every
+scope the analyzer computes the *abstract collective sequence* — the
+source-ordered tree of ``(op, axis)`` events a rank executes, with
+``cond`` alternatives and loop bodies kept structural and resolvable
+calls (including dict-dispatch and re-export edges) spliced inline.
+Ranks of an SPMD mesh deadlock exactly when their sequences diverge, so:
+
+- **COL005** — a collective reachable under a ``lax.cond``/``switch``
+  whose predicate derives from TRACED data (interprocedural taint):
+  unless the predicate is replicated-uniform, ranks disagree on the
+  branch and the collective is entered by a subset of the mesh.
+- **COL006** — ``lax.cond``/``switch`` branches that BOTH execute
+  collectives but in differing sequences: even a uniform predicate
+  cannot save mismatched orders across program versions of one rank
+  pairing with another (COL003 owns the some-branch-has-none case).
+- **COL007** — a collective inside a loop whose trip count can depend
+  on traced data: any ``lax.while_loop`` (its trip count is data-driven
+  by construction), or a ``lax.fori_loop`` whose bounds are tainted.
+  Ranks that disagree on the trip count execute different collective
+  counts and deadlock.
+- **COL008** — two ``ppermute``-family sites in one scope on the same
+  axis with *different known ring shifts*: a double-buffered pipeline
+  must send along ONE consistent ring or the send/recv partners never
+  pair up.  Shifts are read from ``ppermute_shift(..., shift=K, ...)``
+  constants or the ``[(i, (i +/- K) %% size) ...]`` comprehension idiom;
+  unknown shifts stay silent.
 """
 
 from __future__ import annotations
 
 import ast
 
-from .. import reachability
+from .. import dataflow, reachability
 from ..model import Finding, Rule, register
 
 #: lax collective primitives (and the repo's comm/collectives.py wrappers)
@@ -156,6 +183,29 @@ class _AxisClassifier:
                 return _OK
             return _UNKNOWN
         return _UNKNOWN
+
+    def normalize(self, expr: ast.AST | None) -> str:
+        """Stable string form of an axis expr for sequence comparison:
+        vocabulary constants and literals collapse to the axis name,
+        parameters/locals to a symbolic ``$name``, anything else "?"."""
+        if expr is None:
+            return "?"
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return "(" + ",".join(self.normalize(e)
+                                  for e in expr.elts) + ")"
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return expr.value
+        if self._is_const(expr):
+            if isinstance(expr, ast.Attribute):
+                return self.consts.get(expr.attr, "?")
+            if self.rel.endswith(GRID_MODULE_SUFFIX) and \
+                    expr.id in self.consts:
+                return self.consts[expr.id]
+            dotted = self.reach.imports.get(self.rel, {}).get(expr.id, "")
+            return self.consts.get(dotted.rsplit(".", 1)[-1], "?")
+        if isinstance(expr, ast.Name):
+            return "$" + expr.id
+        return "?"
 
 
 def _iter_function_scopes(project):
@@ -342,3 +392,400 @@ class CallbackOutsideFaultSeam(Rule):
                         f"callbacks are restricted to the registered "
                         f"fault-consumption sites so ordering and retrace "
                         f"semantics stay auditable in one place")
+
+
+# --------------------------------------------------------------------------
+# Collective-sequence abstract interpretation (COL005-COL008)
+# --------------------------------------------------------------------------
+
+def _call_name(node: ast.Call) -> str | None:
+    f = node.func
+    return (f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None)
+
+
+def _cond_branches(node: ast.Call) -> tuple[str | None, list[ast.AST]]:
+    """(callee name, branch callables) for lax.cond/lax.switch calls."""
+    name = _call_name(node)
+    if name == "cond" and len(node.args) >= 3:
+        return name, [node.args[1], node.args[2]]
+    if name == "switch" and len(node.args) >= 2 and \
+            isinstance(node.args[1], (ast.List, ast.Tuple)):
+        return name, list(node.args[1].elts)
+    return name, []
+
+
+#: loop primitive -> positional indices of the body/cond callables
+_LOOP_BODY_ARGS = {"fori_loop": (2,), "while_loop": (0, 1), "scan": (0,)}
+
+#: ring-collective family checked by COL008
+_PPERMUTE_FAMILY = {"ppermute", "ppermute_shift"}
+
+
+class _SeqAnalyzer:
+    """Abstract collective sequence of a scope, as a comparable tuple tree.
+
+    Events: ``("c", op, axis)`` — one collective execution with its
+    normalized axis; ``("cond", (seq, ...))`` — branch alternatives
+    (lax.cond/switch and Python if, whose arms are static program
+    versions); ``("loop", seq)`` — a repeated body; ``("?",)`` — an
+    unresolvable branch callable; ``("cycle",)`` — recursion cut.
+    Resolvable calls (incl. dispatch-table and re-export edges) splice
+    the callee's sequence inline, memoized per function."""
+
+    def __init__(self, project, reach):
+        self.project = project
+        self.reach = reach
+        self.fn_memo: dict[str, tuple] = {}
+        self._clfs: dict[str, _AxisClassifier] = {}
+
+    def _clf(self, scope, module) -> _AxisClassifier:
+        key = scope.key if scope is not None else f"{module.rel}::<module>"
+        if key not in self._clfs:
+            self._clfs[key] = _AxisClassifier(
+                self.project, self.reach, scope, module.rel)
+        return self._clfs[key]
+
+    def of_function(self, key: str, stack: frozenset = frozenset()) -> tuple:
+        if key in stack:
+            return (("cycle",),)
+        if key in self.fn_memo:
+            return self.fn_memo[key]
+        info = self.reach.functions.get(key)
+        if info is None:
+            return ()
+        body = info.node.body
+        if isinstance(body, list):
+            seq = self._stmts(body, info, info.module, stack | {key})
+        else:  # lambda-valued node
+            seq = self._walk(body, info, info.module, stack | {key})
+        self.fn_memo[key] = seq
+        return seq
+
+    def branch_seq(self, expr: ast.AST, scope, module,
+                   stack: frozenset = frozenset()):
+        """Sequence of a branch/body callable; None when unresolvable."""
+        if isinstance(expr, ast.Lambda):
+            return self._walk(expr.body, scope, module, stack)
+        if isinstance(expr, ast.Call):
+            # functools.partial(fn, ...): the wrapped fn's sequence
+            if _call_name(expr) == "partial" and expr.args:
+                return self.branch_seq(expr.args[0], scope, module, stack)
+            return None
+        key = None
+        if isinstance(expr, ast.Name):
+            key = self.reach.resolve_name(expr.id, scope, module.rel)
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            key = self.reach.resolve_attr(expr.value.id, expr.attr,
+                                          module.rel)
+        if key:
+            return self.of_function(key, stack)
+        return None
+
+    def _stmts(self, stmts, scope, module, stack) -> tuple:
+        out: list = []
+        for s in stmts:
+            out.extend(self._walk(s, scope, module, stack))
+        return tuple(out)
+
+    def _walk(self, node, scope, module, stack) -> tuple:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return ()  # executes only when called; spliced at call sites
+        if isinstance(node, ast.Call):
+            return self._call(node, scope, module, stack)
+        if isinstance(node, (ast.If, ast.IfExp)):
+            out = list(self._walk(node.test, scope, module, stack))
+            if isinstance(node, ast.If):
+                alts = (self._stmts(node.body, scope, module, stack),
+                        self._stmts(node.orelse, scope, module, stack))
+            else:
+                alts = (self._walk(node.body, scope, module, stack),
+                        self._walk(node.orelse, scope, module, stack))
+            if any(alts):
+                out.append(("cond", alts))
+            return tuple(out)
+        if isinstance(node, (ast.For, ast.While)):
+            head = node.iter if isinstance(node, ast.For) else node.test
+            out = list(self._walk(head, scope, module, stack))
+            body = self._stmts(list(node.body) + list(node.orelse),
+                               scope, module, stack)
+            if body:
+                out.append(("loop", body))
+            return tuple(out)
+        out = []
+        for child in ast.iter_child_nodes(node):
+            out.extend(self._walk(child, scope, module, stack))
+        return tuple(out)
+
+    def _call(self, node: ast.Call, scope, module, stack) -> tuple:
+        name, branches = _cond_branches(node)
+        if branches and node.args:
+            out = list(self._walk(node.args[0], scope, module, stack))
+            operands = node.args[3:] if name == "cond" else node.args[2:]
+            for a in operands:
+                out.extend(self._walk(a, scope, module, stack))
+            for kw in node.keywords:
+                out.extend(self._walk(kw.value, scope, module, stack))
+            alts = []
+            for b in branches:
+                s = self.branch_seq(b, scope, module, stack)
+                alts.append((("?",),) if s is None else s)
+            if any(alts):
+                out.append(("cond", tuple(alts)))
+            return tuple(out)
+        if name in _LOOP_BODY_ARGS:
+            idxs = _LOOP_BODY_ARGS[name]
+            body: list = []
+            out = []
+            for i, a in enumerate(node.args):
+                if i in idxs:
+                    body.extend(self.branch_seq(a, scope, module, stack)
+                                or ())
+                else:
+                    out.extend(self._walk(a, scope, module, stack))
+            for kw in node.keywords:
+                out.extend(self._walk(kw.value, scope, module, stack))
+            if body:
+                out.append(("loop", tuple(body)))
+            return tuple(out)
+        cname = _collective_call(node)
+        if cname is not None:
+            out = []
+            for a in node.args:
+                out.extend(self._walk(a, scope, module, stack))
+            for kw in node.keywords:
+                out.extend(self._walk(kw.value, scope, module, stack))
+            axis = _axis_expr(node, cname)
+            out.append(("c", cname,
+                        self._clf(scope, module).normalize(axis)))
+            return tuple(out)
+        out = []
+        for child in ast.iter_child_nodes(node):
+            out.extend(self._walk(child, scope, module, stack))
+        for t in sorted(self.reach.resolve_call_targets(
+                node, scope, module.rel)):
+            out.extend(self.of_function(t, stack))
+        return tuple(out)
+
+
+def _fmt_seq(seq) -> str:
+    parts = []
+    for ev in seq:
+        if ev[0] == "c":
+            parts.append(f"{ev[1]}@{ev[2]}")
+        elif ev[0] == "cond":
+            parts.append(
+                "cond{" + " | ".join(_fmt_seq(s) for s in ev[1]) + "}")
+        elif ev[0] == "loop":
+            parts.append("loop[" + _fmt_seq(ev[1]) + "]")
+        else:
+            parts.append("<" + ev[0] + ">")
+    return " ; ".join(parts) if parts else "(none)"
+
+
+@register
+class CollectiveUnderTaintedCond(Rule):
+    id = "COL005"
+    summary = ("collective under a lax.cond/switch whose predicate "
+               "derives from traced data — a rank-varying predicate "
+               "splits the mesh at the collective")
+
+    def run(self, project):
+        reach, taints = dataflow.taints(project)
+        creach = _CollectiveReach(reach)
+        for key in sorted(taints):
+            info = reach.functions[key]
+            ta = taints[key]
+            for node in reachability.own_nodes(info.node):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name, branches = _cond_branches(node)
+                if len(branches) < 2:
+                    continue
+                if not ta.expr_tainted(node.args[0]):
+                    continue
+                has = [creach.branch_has(b, info, info.module.rel)
+                       for b in branches]
+                if any(h is True for h in has):
+                    yield Finding(
+                        self.id, info.module.rel, node.lineno,
+                        f"collective under a `{name}` in `{info.qual}` "
+                        f"whose predicate derives from traced data — "
+                        f"unless every rank computes the identical "
+                        f"predicate, part of the mesh enters the "
+                        f"collective and the rest does not; hoist the "
+                        f"collective out of the branch, or suppress "
+                        f"stating why the predicate is replicated-uniform")
+
+
+@register
+class CondSequenceMismatch(Rule):
+    id = "COL006"
+    summary = ("lax.cond/switch branches execute DIFFERING collective "
+               "sequences — the branch arms are incompatible program "
+               "versions for the mesh")
+
+    def run(self, project):
+        reach = reachability.compute(project)
+        seqa = _SeqAnalyzer(project, reach)
+        for _, scope, module in _iter_function_scopes(project):
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                name, branches = _cond_branches(node)
+                if len(branches) < 2:
+                    continue
+                seqs = [seqa.branch_seq(b, scope, module) for b in branches]
+                if any(s is None for s in seqs):
+                    continue  # unresolvable branch: stay silent
+                if all(seqs) and len(set(seqs)) > 1:
+                    shown = " vs ".join(_fmt_seq(s)
+                                        for s in dict.fromkeys(seqs))
+                    yield Finding(
+                        self.id, module.rel, node.lineno,
+                        f"`{name}` branches execute differing collective "
+                        f"sequences ({shown}) — even under a uniform "
+                        f"predicate the arms are distinct mesh programs; "
+                        f"make the sequences identical, or suppress "
+                        f"stating why the divergence is safe")
+
+
+@register
+class CollectiveInDataDependentLoop(Rule):
+    id = "COL007"
+    summary = ("collective inside a loop whose trip count can depend on "
+               "traced data (lax.while_loop, or fori_loop with tainted "
+               "bounds) — ranks disagreeing on the count deadlock")
+
+    def run(self, project):
+        reach, taints = dataflow.taints(project)
+        creach = _CollectiveReach(reach)
+        for _, scope, module in _iter_function_scopes(project):
+            ta = taints.get(scope.key) if scope is not None else None
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name == "while_loop" and len(node.args) >= 2:
+                    has = [creach.branch_has(node.args[0], scope,
+                                             module.rel),
+                           creach.branch_has(node.args[1], scope,
+                                             module.rel)]
+                    if any(h is True for h in has):
+                        yield Finding(
+                            self.id, module.rel, node.lineno,
+                            f"collective inside `lax.while_loop` — the "
+                            f"trip count is data-dependent by "
+                            f"construction, so ranks can execute "
+                            f"different collective counts and deadlock; "
+                            f"bound the loop with fori_loop/scan or run "
+                            f"the collective outside, or suppress "
+                            f"stating why the condition is "
+                            f"replicated-uniform")
+                elif name == "fori_loop" and len(node.args) >= 3 \
+                        and ta is not None:
+                    if creach.branch_has(node.args[2], scope,
+                                         module.rel) is True and \
+                            (ta.expr_tainted(node.args[0])
+                             or ta.expr_tainted(node.args[1])):
+                        yield Finding(
+                            self.id, module.rel, node.lineno,
+                            f"collective inside `lax.fori_loop` whose "
+                            f"bounds derive from traced data — ranks "
+                            f"disagreeing on the trip count execute "
+                            f"different collective counts and deadlock; "
+                            f"make the bounds static, or suppress "
+                            f"stating why the bounds are "
+                            f"replicated-uniform")
+
+
+def _shift_const(expr: ast.AST | None) -> int | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int) \
+            and not isinstance(expr.value, bool):
+        return expr.value
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub) and \
+            isinstance(expr.operand, ast.Constant) and \
+            isinstance(expr.operand.value, int):
+        return -expr.operand.value
+    return None
+
+
+def _ring_shift(node: ast.Call, name: str) -> int | None:
+    """Known ring shift of a ppermute-family call, else None.
+
+    ``ppermute_shift(x, axis, K, size)`` reads the shift arg directly;
+    ``ppermute(x, axis, perm)`` recognises the canonical ring
+    comprehension ``[(i, (i +/- K) % size) for i in range(size)]``."""
+    expr = None
+    want = "shift" if name == "ppermute_shift" else "perm"
+    for kw in node.keywords:
+        if kw.arg == want:
+            expr = kw.value
+    if expr is None and len(node.args) > 2:
+        expr = node.args[2]
+    if name == "ppermute_shift":
+        return _shift_const(expr)
+    if not isinstance(expr, ast.ListComp) or len(expr.generators) != 1:
+        return None
+    elt = expr.elt
+    if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2):
+        return None
+    src, dst = elt.elts
+    if not isinstance(src, ast.Name):
+        return None
+    if isinstance(dst, ast.BinOp) and isinstance(dst.op, ast.Mod):
+        inner = dst.left
+        if isinstance(inner, ast.BinOp) and \
+                isinstance(inner.left, ast.Name) and \
+                inner.left.id == src.id and \
+                isinstance(inner.right, ast.Constant) and \
+                isinstance(inner.right.value, int):
+            if isinstance(inner.op, ast.Add):
+                return inner.right.value
+            if isinstance(inner.op, ast.Sub):
+                return -inner.right.value
+    return None
+
+
+@register
+class PpermuteRingMismatch(Rule):
+    id = "COL008"
+    summary = ("two ppermute-family calls in one scope on the same axis "
+               "with different known ring shifts — send/recv partners "
+               "never pair up")
+
+    def run(self, project):
+        for reach, scope, module in _iter_function_scopes(project):
+            clf = None
+            groups: dict[str, list[tuple[int | None, ast.Call]]] = {}
+            for node in _scope_nodes(scope, module):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _collective_call(node)
+                if cname not in _PPERMUTE_FAMILY:
+                    continue
+                if clf is None:
+                    clf = _AxisClassifier(project, reach, scope, module.rel)
+                axis = clf.normalize(_axis_expr(node, cname))
+                groups.setdefault(axis, []).append(
+                    (_ring_shift(node, cname), node))
+            for axis in sorted(groups):
+                known = sorted(((s, n) for s, n in groups[axis]
+                                if s is not None),
+                               key=lambda sn: (sn[1].lineno,
+                                               sn[1].col_offset))
+                shifts = sorted({s for s, _ in known})
+                if len(shifts) < 2:
+                    continue
+                first = known[0][0]
+                anchor = next(n for s, n in known if s != first)
+                yield Finding(
+                    self.id, module.rel, anchor.lineno,
+                    f"ppermute ring partners disagree within one scope "
+                    f"on axis `{axis}` (shifts {shifts}) — a "
+                    f"double-buffered pipeline must send along ONE "
+                    f"consistent ring or sends never meet their "
+                    f"receives; unify the shift, or suppress stating "
+                    f"why two rings are intended")

@@ -1,0 +1,375 @@
+"""Lock-discipline rules (CON0xx), driven by a declared registry of
+guarded state.
+
+The framework has exactly three pieces of cross-thread mutable state —
+the server's pending queue, the executable cache's store/counters, and
+the obs event sinks — each guarded by one ``threading.Lock``.  Rather
+than guess at lock/state association, the registry below DECLARES it:
+one :class:`LockSpec` per lock names the module, the owning class (None
+for module-level locks), the lock's attribute/global name, and the
+state names it guards.  Growing a new locked subsystem means adding one
+registry line; the rules then hold it to the same discipline.
+
+Rules:
+
+- **CON001** — guarded state accessed without holding its lock.  The
+  walker tracks the held-lock set through ``with <lock>:`` blocks
+  (resetting inside nested ``def``/``lambda``, which run later);
+  ``__init__``/``__new__`` and module top level are exempt
+  (single-threaded construction/import happens-before publication).
+  Designed lock-free fast-path peeks are suppressed inline with a
+  reason, which keeps every such peek an audited decision.
+- **CON002** — lock-ordering inversion: one code path acquires lock B
+  while holding A (directly nested ``with``, or a call whose transitive
+  callees acquire B — resolved over the cross-module call graph,
+  including ``self.helper()`` method edges) while another path acquires
+  A while holding B.  Also fires on a path re-acquiring the lock it
+  already holds — ``threading.Lock`` is non-reentrant, so that is a
+  self-deadlock, the bug class ``timing()`` would hit if it called
+  ``set_timing`` under ``_LOCK``.
+- **CON003** — a known-blocking call under a held lock: the jax AOT
+  chain (``jit().lower``/``lower().compile``), ``block_until_ready``,
+  or ``sleep``.  Compilation takes seconds; doing it under the cache
+  lock would serialize every concurrent submit behind one compile
+  (cache.py deliberately compiles OUTSIDE the lock and re-checks).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import NamedTuple
+
+from .. import callgraph, reachability
+from ..model import Finding, Rule, register
+
+
+class LockSpec(NamedTuple):
+    """One declared lock and the state it guards."""
+    module: str          # rel path of the declaring module
+    cls: str | None      # owning class, None for a module-level lock
+    lock: str            # attribute (``self.<lock>``) or global name
+    guards: tuple        # state names the lock protects
+
+    @property
+    def key(self) -> str:
+        scope = f"{self.cls}." if self.cls else ""
+        return f"{self.module}::{scope}{self.lock}"
+
+
+#: the guarded-state registry (docs/STATIC_ANALYSIS.md documents the
+#: format).  One line per lock; CON001-CON003 enforce the discipline.
+LOCK_REGISTRY: tuple[LockSpec, ...] = (
+    LockSpec("slate_tpu/serve/server.py", "Server", "_lock",
+             ("_pending",)),
+    LockSpec("slate_tpu/serve/cache.py", "ExecutableCache", "_lock",
+             ("_exes", "_hits", "_misses", "_compile_ms")),
+    LockSpec("slate_tpu/obs/events.py", None, "_LOCK",
+             ("_CFG", "_RING", "_COLLECTORS")),
+)
+
+#: constructors run happens-before publication; module top level is
+#: import-time single-threaded.  Both are exempt from CON001.
+_EXEMPT_METHODS = {"__init__", "__new__"}
+
+
+def _acquired_spec(expr: ast.AST, rel: str,
+                   cls: str | None) -> LockSpec | None:
+    """The registry lock a ``with`` context expression acquires, if any."""
+    for spec in LOCK_REGISTRY:
+        if spec.module != rel:
+            continue
+        if spec.cls is None:
+            if isinstance(expr, ast.Name) and expr.id == spec.lock:
+                return spec
+        elif cls == spec.cls:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and expr.attr == spec.lock:
+                return spec
+    return None
+
+
+def _is_access(node: ast.AST, spec: LockSpec) -> str | None:
+    """The guarded name ``node`` reads/writes, if any."""
+    if spec.cls is not None:
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self" and node.attr in spec.guards:
+            return node.attr
+    elif isinstance(node, ast.Name) and node.id in spec.guards:
+        return node.id
+    return None
+
+
+def _top_defs(body):
+    """Top-level functions and class methods: the roots CON001 checks.
+    Nested defs are handled by the walker itself (held-set reset)."""
+    for node in body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+def _unlocked_accesses(node, spec: LockSpec, cls: str | None, held: bool):
+    """Yield (access node, guarded name) reached with the lock not held."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)):
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for s in body:  # runs later: the lock is NOT held then
+            yield from _unlocked_accesses(s, spec, cls, False)
+        return
+    if isinstance(node, (ast.With, ast.AsyncWith)):
+        inner = held
+        for item in node.items:
+            yield from _unlocked_accesses(item.context_expr, spec, cls,
+                                          held)
+            if _acquired_spec(item.context_expr, spec.module, cls) is spec:
+                inner = True
+        for s in node.body:
+            yield from _unlocked_accesses(s, spec, cls, inner)
+        return
+    name = _is_access(node, spec)
+    if name is not None and not held:
+        yield node, name
+    for child in ast.iter_child_nodes(node):
+        yield from _unlocked_accesses(child, spec, cls, held)
+
+
+@register
+class GuardedStateUnlocked(Rule):
+    id = "CON001"
+    summary = ("registered guarded state accessed without holding its "
+               "lock — wrap in `with <lock>:` or suppress a designed "
+               "lock-free peek with a reason")
+
+    def run(self, project):
+        for spec in LOCK_REGISTRY:
+            mod = project.modules.get(spec.module)
+            if mod is None:
+                continue
+            for cls, fn in _top_defs(mod.tree.body):
+                if fn.name in _EXEMPT_METHODS:
+                    continue
+                if spec.cls is not None and cls != spec.cls:
+                    continue
+                for stmt in fn.body:
+                    for node, name in _unlocked_accesses(
+                            stmt, spec, cls, False):
+                        lock = (f"self.{spec.lock}" if spec.cls
+                                else spec.lock)
+                        yield Finding(
+                            self.id, spec.module, node.lineno,
+                            f"`{name}` is declared guarded by `{lock}` "
+                            f"(lock registry, rules/concurrency.py) but "
+                            f"`{fn.name}` touches it without holding the "
+                            f"lock — a racing thread tears the state; "
+                            f"wrap the access in `with {lock}:`, or "
+                            f"suppress stating why lock-free access is "
+                            f"safe here")
+
+
+# --------------------------------------------------------------- CON002/3
+
+
+def _node_cls(info) -> str | None:
+    return getattr(info, "cls", None)
+
+
+def _direct_locks(info) -> set[str]:
+    """Lock keys a function/method body may acquire (over-approximate:
+    includes nested defs, which its callers can invoke)."""
+    rel, cls = info.module.rel, _node_cls(info)
+    out: set[str] = set()
+    for n in ast.walk(info.node):
+        if isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                spec = _acquired_spec(item.context_expr, rel, cls)
+                if spec is not None:
+                    out.add(spec.key)
+    return out
+
+
+def _call_targets(call: ast.Call, info, cg) -> set[str]:
+    """Call-graph keys a call site may reach, incl. self.method edges."""
+    rel = info.module.rel
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self" and \
+            isinstance(info, callgraph.MethodInfo):
+        mkey = f"{rel}::{info.cls}.{f.attr}"
+        if mkey in cg.methods:
+            return {mkey}
+    scope = info if isinstance(info, reachability.FuncInfo) else None
+    return cg.reach.resolve_call_targets(call, scope, rel)
+
+
+class _AcquireSummary:
+    """Transitive may-acquire lock sets over the call graph."""
+
+    def __init__(self, cg):
+        self.cg = cg
+        self.memo: dict[str, set[str]] = {}
+
+    def of(self, key: str) -> set[str]:
+        if key in self.memo:
+            return self.memo[key]
+        self.memo[key] = set()          # cycle guard
+        info = self.cg.nodes.get(key)
+        if info is None:
+            return set()
+        out = _direct_locks(info)
+        for callee in self.cg.callees(key):
+            out |= self.of(callee)
+        self.memo[key] = out
+        return out
+
+
+def _held_pairs(info, cg, summary: _AcquireSummary):
+    """Yield (held lock key, acquired lock key, lineno) for every
+    acquisition — nested ``with`` or transitive via a call — performed
+    while a registry lock is held."""
+    rel, cls = info.module.rel, _node_cls(info)
+
+    def walk(node, held):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            for s in body:
+                yield from walk(s, ())
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                yield from walk(item.context_expr, held)
+                spec = _acquired_spec(item.context_expr, rel, cls)
+                if spec is not None:
+                    for h in inner:
+                        yield h, spec.key, node.lineno
+                    inner.append(spec.key)
+            for s in node.body:
+                yield from walk(s, tuple(inner))
+            return
+        if isinstance(node, ast.Call) and held:
+            for t in sorted(_call_targets(node, info, cg)):
+                for acquired in sorted(summary.of(t)):
+                    for h in held:
+                        yield h, acquired, node.lineno
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, held)
+
+    for stmt in info.node.body:
+        yield from walk(stmt, ())
+
+
+@register
+class LockOrderInversion(Rule):
+    id = "CON002"
+    summary = ("two paths acquire the same two locks in opposite order "
+               "(or one path re-acquires a non-reentrant lock) — "
+               "deadlock by schedule")
+
+    def run(self, project):
+        if not any(s.module in project.modules for s in LOCK_REGISTRY):
+            return
+        cg = callgraph.compute(project)
+        summary = _AcquireSummary(cg)
+        pairs: dict = {}                # (held, acquired) -> (rel, line)
+        for key in sorted(cg.nodes):
+            info = cg.nodes[key]
+            for held, acquired, line in _held_pairs(info, cg, summary):
+                pairs.setdefault((held, acquired),
+                                 (info.module.rel, line))
+        for (a, b) in sorted(pairs):
+            rel, line = pairs[(a, b)]
+            if a == b:
+                yield Finding(
+                    self.id, rel, line,
+                    f"path re-acquires `{a}` while already holding it — "
+                    f"threading.Lock is non-reentrant, so this "
+                    f"self-deadlocks; release first or restructure the "
+                    f"callee to expect the lock held")
+            elif a < b and (b, a) in pairs:
+                orel, oline = pairs[(b, a)]
+                yield Finding(
+                    self.id, rel, line,
+                    f"lock-order inversion: this path acquires `{b}` "
+                    f"while holding `{a}`, but {orel}:{oline} acquires "
+                    f"`{a}` while holding `{b}` — two threads "
+                    f"interleaving these paths deadlock; pick one global "
+                    f"order and restructure the loser")
+
+
+def _blocking_call(node: ast.Call) -> str | None:
+    f = node.func
+    name = (f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else None)
+    if name in ("block_until_ready", "sleep"):
+        return name
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Call):
+        vf = f.value.func
+        vname = (vf.id if isinstance(vf, ast.Name)
+                 else vf.attr if isinstance(vf, ast.Attribute) else None)
+        if name == "lower" and vname == "jit":
+            return "jit(...).lower"
+        if name == "compile" and vname == "lower":
+            return "lower(...).compile"
+    return None
+
+
+@register
+class BlockingCallUnderLock(Rule):
+    id = "CON003"
+    summary = ("known-blocking call (jit/lower/compile chain, "
+               "block_until_ready, sleep) under a held registry lock — "
+               "serializes every other thread behind seconds of wait")
+
+    def run(self, project):
+        if not any(s.module in project.modules for s in LOCK_REGISTRY):
+            return
+        cg = callgraph.compute(project)
+        for key in sorted(cg.nodes):
+            info = cg.nodes[key]
+            yield from self._check(info)
+
+    def _check(self, info):
+        rel, cls = info.module.rel, _node_cls(info)
+
+        def walk(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                body = node.body if isinstance(node.body, list) \
+                    else [node.body]
+                for s in body:
+                    yield from walk(s, None)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in node.items:
+                    yield from walk(item.context_expr, held)
+                    spec = _acquired_spec(item.context_expr, rel, cls)
+                    if spec is not None:
+                        inner = spec
+                for s in node.body:
+                    yield from walk(s, inner)
+                return
+            if isinstance(node, ast.Call) and held is not None:
+                what = _blocking_call(node)
+                if what is not None:
+                    lock = (f"self.{held.lock}" if held.cls else held.lock)
+                    yield Finding(
+                        self.id, rel, node.lineno,
+                        f"`{what}` under held `{lock}` — compilation/"
+                        f"device sync takes seconds and every thread "
+                        f"contending for the lock stalls behind it; move "
+                        f"the blocking work outside the critical section "
+                        f"and re-check state after re-acquiring "
+                        f"(cache.py's compile-outside-the-lock pattern)")
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, held)
+
+        for stmt in info.node.body:
+            yield from walk(stmt, None)

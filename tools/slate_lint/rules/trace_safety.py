@@ -22,12 +22,16 @@ import ast
 from .. import dataflow, reachability
 from ..model import Finding, Rule, register
 
-#: modules whose raises are the designed eager policy seam
+#: modules whose raises are the designed eager policy seam.  tune/plans.py
+#: qualifies like options.py: resolve_plan/validate_cache run at trace
+#: time (tuned dispatch over static shapes) and raise only on malformed
+#: host-side plan-cache config.
 EAGER_BOUNDARY_MODULES = {
     "slate_tpu/robust/health.py",
     "slate_tpu/robust/recovery.py",
     "slate_tpu/exceptions.py",
     "slate_tpu/options.py",
+    "slate_tpu/tune/plans.py",
 }
 
 
@@ -36,38 +40,12 @@ def _numpy_aliases(imports: dict[str, str]) -> set[str]:
             if dotted == "numpy" or dotted.startswith("numpy.")}
 
 
-def _taints(project):
-    """Taint analyses for every traced function, parents before children
-    so closures inherit the enclosing function's tainted names."""
-    if "taints" in project.cache:
-        return project.cache["taints"]
-    reach = reachability.compute(project)
-    memo: dict[str, dataflow.TaintAnalysis] = {}
-
-    def get(key: str) -> dataflow.TaintAnalysis:
-        if key in memo:
-            return memo[key]
-        info = reach.functions[key]
-        inherited = frozenset()
-        if info.parent is not None and info.parent.key in reach.traced:
-            inherited = frozenset(get(info.parent.key).tainted)
-        memo[key] = dataflow.analyze(
-            info, reach.imports[info.module.rel],
-            reach.taint_all_params(info), inherited)
-        return memo[key]
-
-    for key in reach.traced:
-        if key in reach.functions:
-            get(key)
-    project.cache["taints"] = (reach, memo)
-    return project.cache["taints"]
-
-
 class _TraceRule(Rule):
-    """Shared driver: subclasses implement ``visit`` per traced node."""
+    """Shared driver: subclasses implement ``visit`` per traced node.
+    Taint comes from the interprocedural builder (dataflow.taints)."""
 
     def run(self, project):
-        reach, taints = _taints(project)
+        reach, taints = dataflow.taints(project)
         for key in sorted(taints):
             info = reach.functions[key]
             ta = taints[key]
