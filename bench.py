@@ -409,6 +409,169 @@ def bench_geqrf_panel(m, n, iters):
     _emit(f"geqrf_panel_m{m}_n{n}_gflops_per_chip", timed, flops)
 
 
+def _lookahead_grid():
+    """Largest supported process grid on this host: (2,2) with >=4
+    devices, a 1-D ring with 2, degenerate (1,1) otherwise (rings of
+    size 1 have zero hops — the bench still runs and reports)."""
+    devs = jax.devices()
+    if len(devs) >= 4:
+        p, q = 2, 2
+    elif len(devs) >= 2:
+        p, q = 1, 2
+    else:
+        p, q = 1, 1
+    return st.Grid(p, q, devices=devs[: p * q])
+
+
+def _overlap_probe(g, mtl, ntl, nb, op, both_axes=True, reps=5):
+    """overlap_pct for the PERF r15 pipeline: the share of one step's
+    panel ring-broadcast wall time that the same step's local
+    accumulate can hide — sum(min(bcast_i, acc_i)) / sum(bcast_i) over
+    ``reps`` eagerly timed phase pairs.  100% means depth-1 lookahead
+    fully hides the broadcast; the phases are timed under the SAME span
+    names the jitted pipeline emits (slate.<op>/bcast_ahead vs
+    /accumulate) so the flight recorder and this probe agree on
+    vocabulary.  ``both_axes`` times the SUMMA pair of rings (A panel
+    along q, B panel along p); off, the factorization single col-ring."""
+    from slate_tpu import obs
+    from slate_tpu.comm.collectives import (ring_bcast_from_col,
+                                            ring_bcast_from_row)
+    from slate_tpu.core.grid import TILE_SPEC
+    from slate_tpu.util.trace import span
+
+    spec = TILE_SPEC
+    p, q = g.p, g.q
+
+    def _bcast(apan, bpan):
+        out = ring_bcast_from_col(apan, 0, q)
+        if both_axes:
+            return out, ring_bcast_from_row(bpan, 0, p)
+        return out, bpan
+
+    def _acc(apan, bpan, c):
+        return c + jnp.einsum("mkab,knbc->mnac", apan, bpan)
+
+    bc = jax.jit(jax.shard_map(_bcast, mesh=g.mesh, in_specs=(spec, spec),
+                               out_specs=(spec, spec)))
+    ac = jax.jit(jax.shard_map(_acc, mesh=g.mesh,
+                               in_specs=(spec, spec, spec),
+                               out_specs=spec))
+    rng = np.random.default_rng(15)
+    apan = jnp.asarray(rng.standard_normal(
+        (p * mtl, q, nb, nb)).astype(np.float32))
+    bpan = jnp.asarray(rng.standard_normal(
+        (p, q * ntl, nb, nb)).astype(np.float32))
+    c = jnp.zeros((p * mtl, q * ntl, nb, nb), jnp.float32)
+    jax.block_until_ready(bc(apan, bpan))          # compile outside timing
+    jax.block_until_ready(ac(apan, bpan, c))
+    with obs.record_spans() as rec:
+        for _ in range(reps):
+            with span(f"slate.{op}/bcast_ahead"):
+                jax.block_until_ready(bc(apan, bpan))
+            with span(f"slate.{op}/accumulate"):
+                jax.block_until_ready(ac(apan, bpan, c))
+    bts = [s["dur_ms"] for s in rec.spans
+           if s["name"].endswith("/bcast_ahead")]
+    ats = [s["dur_ms"] for s in rec.spans
+           if s["name"].endswith("/accumulate")]
+    hidden = sum(min(b, a) for b, a in zip(bts, ats))
+    return 100.0 * hidden / max(sum(bts), 1e-12)
+
+
+def bench_summa_lookahead(n, nb, iters):
+    """Lookahead-pipelined SUMMA (PERF r15): GFLOP/s at depth 0 (the
+    bulk-synchronous oracle) vs the tuned ring-pipeline depth, their
+    ratio, and overlap_pct — how much of the per-step panel broadcast
+    the trailing accumulate can hide.  Depths produce bit-identical
+    output (tests/test_lookahead.py), so the speedup line is pure
+    schedule, no numerics."""
+    from slate_tpu.core.layout import num_tiles
+    from slate_tpu.parallel.summa import summa_gemm_data
+    from slate_tpu.tune import lookahead_depth
+
+    g = _lookahead_grid()
+    p, q = g.p, g.q
+    rng = np.random.default_rng(15)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    b = rng.standard_normal((n, n)).astype(np.float32)
+    A = st.Matrix.from_numpy(a, nb, nb, g)
+    B = st.Matrix.from_numpy(b, nb, nb, g)
+    C = st.Matrix.from_numpy(np.zeros((n, n), np.float32), nb, nb, g)
+    Kt = num_tiles(n, nb)
+    la = max(1, lookahead_depth(n, "float32"))
+
+    flops = _flops.op_flops("gemm", [(n, n), (n, n)])
+    gf = {}
+    for depth in (0, la):
+        def body(carry, ad, bd, depth=depth):
+            return summa_gemm_data(ad, bd, carry, 1.0 / n, 0.0, Kt, g,
+                                   la=depth)
+        timed = _time_chain(body, C.storage.data,
+                            (A.storage.data, B.storage.data), iters,
+                            flops)
+        gf[depth] = timed[0]
+        _emit(f"summa_lookahead_d{depth}_n{n}_gflops", timed, flops,
+              {"nb": nb, "grid": f"{p}x{q}", "la": depth})
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": f"summa_lookahead_speedup_n{n}",
+                      "value": round(gf[la] / max(gf[0], 1e-9), 3),
+                      "unit": "x", "la": la, "grid": f"{p}x{q}"}),
+          flush=True)
+    mtl = A.storage.data.shape[0] // p
+    ntl = C.storage.data.shape[1] // q
+    ov = _overlap_probe(g, mtl, ntl, nb, "gemm", both_axes=True)
+    print(json.dumps({**base,
+                      "metric": f"summa_lookahead_overlap_pct_n{n}",
+                      "value": round(float(ov), 1), "unit": "%",
+                      "grid": f"{p}x{q}", "nb": nb}), flush=True)
+
+
+def bench_dist_chol_lookahead(n, nb, iters):
+    """Lookahead-pipelined distributed Cholesky (PERF r15): same
+    depth-0-vs-tuned pair as bench_summa_lookahead for dist_potrf —
+    here the lookahead additionally pulls the NEXT panel's column
+    factor forward, so the critical path drops by the panel latency,
+    not just the broadcast."""
+    from slate_tpu.parallel.dist_chol import dist_potrf
+    from slate_tpu.tune import lookahead_depth
+
+    g = _lookahead_grid()
+    p, q = g.p, g.q
+    rng = np.random.default_rng(16)
+    # SPD without an O(n^3) host product (bench_posv idiom)
+    a0 = rng.standard_normal((n, n)).astype(np.float32)
+    a = (a0 + a0.T) * 0.001 + np.eye(n, dtype=np.float32) * 4.0
+    H = st.HermitianMatrix.from_numpy(a, nb, st.Uplo.Lower, g)
+    stg = H.storage
+    la = max(1, lookahead_depth(n, "float32"))
+
+    flops = _flops.op_flops("potrf", [(n, n)])
+    gf = {}
+    for depth in (0, la):
+        def body(carry, data, depth=depth):
+            out = dist_potrf(data * (1.0 + carry), stg.Nt, g, stg.n,
+                             abft=False, la=depth)
+            return out[0][0, 0, 0, 0] * 1e-24
+        timed = _time_chain(body, jnp.float32(0.0), (stg.data,), iters,
+                            flops)
+        gf[depth] = timed[0]
+        _emit(f"dist_chol_lookahead_d{depth}_n{n}_gflops", timed, flops,
+              {"nb": nb, "grid": f"{p}x{q}", "la": depth})
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base,
+                      "metric": f"dist_chol_lookahead_speedup_n{n}",
+                      "value": round(gf[la] / max(gf[0], 1e-9), 3),
+                      "unit": "x", "la": la, "grid": f"{p}x{q}"}),
+          flush=True)
+    mtl = stg.data.shape[0] // p
+    ntl = stg.data.shape[1] // q
+    ov = _overlap_probe(g, mtl, ntl, nb, "potrf", both_axes=False)
+    print(json.dumps({**base,
+                      "metric": f"dist_chol_lookahead_overlap_pct_n{n}",
+                      "value": round(float(ov), 1), "unit": "%",
+                      "grid": f"{p}x{q}", "nb": nb}), flush=True)
+
+
 def bench_serve_mixed(problems, nrhs, reps, sizes):
     """Serving throughput (PR 10): a fixed seeded mixed workload — three
     ops round-robin over ``sizes`` — through serve.Server.  The first
@@ -553,6 +716,8 @@ QUICK_STEPS = [
     (bench_svd, dict(n=512, nb=128, iters=2)),
     (bench_potrf_fused, dict(n=256, nb=128, bw=8, iters=2)),
     (bench_geqrf_panel, dict(m=512, n=128, iters=2)),
+    (bench_summa_lookahead, dict(n=512, nb=128, iters=2)),
+    (bench_dist_chol_lookahead, dict(n=768, nb=128, iters=2)),
     (bench_serve_mixed, dict(problems=24, nrhs=4, reps=2,
                              sizes=(24, 48, 96))),
     (bench_serve_ragged, dict(problems=12, nrhs=4, reps=2, bucket=32)),
@@ -573,6 +738,8 @@ FULL_STEPS = [
     (bench_svd, dict(n=2048, nb=256, iters=3)),
     (bench_potrf_fused, dict(n=4096, nb=256, bw=8, iters=10)),
     (bench_geqrf_panel, dict(m=8192, n=256, iters=10)),
+    (bench_summa_lookahead, dict(n=8192, nb=256, iters=8)),
+    (bench_dist_chol_lookahead, dict(n=16384, nb=512, iters=3)),
     (bench_serve_mixed, dict(problems=96, nrhs=16, reps=3,
                              sizes=(48, 96, 160, 320))),
     (bench_serve_ragged, dict(problems=48, nrhs=16, reps=3, bucket=256)),

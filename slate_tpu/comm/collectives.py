@@ -113,3 +113,41 @@ def ppermute_shift(x, axis: str, shift: int, size: int):
     lax.ppermute is the ICI point-to-point primitive)."""
     perm = [(i, (i + shift) % size) for i in range(size)]
     return lax.ppermute(x, axis, perm)
+
+
+def ring_bcast_along(x, root, axis: str, size: int):
+    """Ring broadcast of ``x`` from the (possibly traced) ``root`` shard.
+
+    Same contract as :func:`bcast_along`, different dataflow: instead of a
+    full-axis masked psum — whose reduction tree is a barrier every shard
+    must enter before any shard leaves — the value hops neighbour-to
+    -neighbour via ``size - 1`` unit-shift ppermutes.  Each hop is an ICI
+    point-to-point send the XLA scheduler can overlap with unrelated
+    compute, which is what lets a lookahead pipeline hide the panel
+    broadcast underneath the trailing update (ref listBcast pipelining,
+    BaseMatrix.hh:2073-2174; SLATE's lookahead tasks, potrf.cc:266-287).
+
+    Pure data movement: the root's bytes are forwarded unchanged, so the
+    result is bit-identical to the masked-psum path for every shard and
+    any root.  The shard at ring distance ``s`` from the root adopts the
+    payload on hop ``s``; everyone else forwards what it already holds.
+    """
+    me = lax.axis_index(axis)
+    dist = (me - root) % size
+    have = jnp.where(dist == 0, x, jnp.zeros_like(x))
+    for s in range(1, size):
+        recv = ppermute_shift(have, axis, 1, size)
+        have = jnp.where(dist == s, recv, have)
+    return have
+
+
+def ring_bcast_from_col(x, root_col, q: int):
+    """Ring variant of :func:`bcast_from_col` (broadcast along the q axis
+    from the column owner, ``q`` mesh columns)."""
+    return ring_bcast_along(x, root_col, AXIS_Q, q)
+
+
+def ring_bcast_from_row(x, root_row, p: int):
+    """Ring variant of :func:`bcast_from_row` (broadcast along the p axis
+    from the row owner, ``p`` mesh rows)."""
+    return ring_bcast_along(x, root_row, AXIS_P, p)

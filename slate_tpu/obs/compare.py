@@ -36,7 +36,8 @@ DEFAULT_GATE_PCT = 10.0
 _LOWER_BETTER = ("waste", "overhead", "latency", "_ms", "compile",
                  "retrace")
 #: metric-name substrings with wider run-to-run noise (percent)
-_NOISY = (("serve", 15.0), ("sweep", 10.0), ("batch", 10.0))
+_NOISY = (("serve", 15.0), ("sweep", 10.0), ("batch", 10.0),
+          ("lookahead", 10.0))
 
 
 def direction(metric: str, unit: str | None = None) -> str:

@@ -15,7 +15,15 @@ listBcastMT(A(i,k) -> row i, col i)    | scatter into a global panel buffer
   :232-242                             |   + psum over both mesh axes
 internal::herk trailing update :254    | einsum over the rank's trailing
                                        |   slice (static shrinking sizes)
-lookahead tasks :266-287               | XLA pipelines across fori_loop steps
+lookahead tasks :266-287               | software pipeline in the fori_loop
+                                       |   carry (``la`` >= 1): columns
+                                       |   k+1..k+la get step k's herk at
+                                       |   priority, panel k+1 is factored
+                                       |   + ring-broadcast next, and only
+                                       |   then the late trailing update
+                                       |   (cols > k+la) runs — so the
+                                       |   in-flight broadcast rides ICI
+                                       |   under the trailing MXU work
 release/tileUpdateAllOrigin :289-302   | SSA buffer lifetimes
 
 Compile-time scaling: the k loop is TWO-LEVEL.  The outer level unrolls
@@ -40,6 +48,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..comm.collectives import ring_bcast_from_col, ring_bcast_from_row
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.herk import herk_panel_update
 from ..robust import abft as _abft
@@ -59,8 +68,20 @@ def superblock(Nt: int, target: int = SUPERBLOCKS) -> int:
 
 
 def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
-                 sb: int, abft: bool = False):
-    """Per-shard body; a_loc [mtl, ntl, nb, nb] block-cyclic local tiles."""
+                 sb: int, abft: bool = False, la: int = 0):
+    """Per-shard body; a_loc [mtl, ntl, nb, nb] block-cyclic local tiles.
+
+    ``la`` (0/1/2, static) is the lookahead depth.  Depth 0 is the
+    bulk-synchronous oracle below.  At depth >= 1 each fori_loop body
+    runs the SLATE lookahead schedule (ref potrf.cc:266-287): columns
+    k+1..k+la receive step k's herk at priority, panel k+1 is factored
+    and ring-broadcast immediately after (carried in flight), and only
+    then does the late trailing update (columns > k+la) consume step k's
+    panel — XLA sees the in-flight broadcast and the late herk as
+    data-independent and can overlap ICI with MXU time.  Every trailing
+    tile is updated exactly once by per-tile-independent einsums and the
+    ring broadcast moves the owner's exact bytes, so any depth is
+    bit-identical to depth 0, ABFT counters included."""
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
@@ -192,62 +213,243 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
                 gpan = faults.maybe_corrupt("post_collective", gpan)
         return (a_loc, minpiv, minidx, rep, loc), gpan
 
-    for k0 in range(0, Nt, sb):
-        k1 = min(k0 + sb, Nt)
-        # static trailing window (max over ranks) for this superblock:
-        # local slots whose global index can be >= k0
-        S = mtl - (k0 // p)
-        T = ntl - (k0 // q)
+    def issue(kn, a_loc, minpiv, minidx, rep, live):
+        """Panel factor + ring broadcast for step ``kn`` — the in-flight
+        half of the software pipeline (``la`` >= 1).  Same arithmetic as
+        ``step`` with the masked-psum broadcasts replaced by ppermute
+        rings (bit-identical: pure data movement).  All state writes are
+        masked by ``live``: the final body iteration re-issues the
+        clamped last panel, whose garbage factor must stay confined to
+        the dropped loop carry."""
+        kn = jnp.asarray(kn, jnp.int32)
+        rk, ck = kn % p, kn % q
+        kkr, kkc = kn // p, kn // q
+        vk = jnp.where(kn < Nt - 1, nb, n - (Nt - 1) * nb)
+        pad_eye = jnp.diag((idx >= vk).astype(dt))
+        vmask = (idx[:, None] < vk) & (idx[None, :] < vk)
 
-        def super_step(k, carry, S=S, T=T):
-            (a_loc, minpiv, minidx, rep, loc), gpan = step(k, carry)
+        with span("slate.potrf/panel"):
+            dtile = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+                kkc, axis=0, keepdims=False)
+            dtile = jnp.where((r == rk) & (c == ck), dtile,
+                              jnp.zeros((nb, nb), dt))
+            dtile = ring_bcast_from_row(dtile, rk, p)
+            dtile = ring_bcast_from_col(dtile, ck, q)
+            dlow = jnp.tril(dtile)
+            ddiag = jnp.diagonal(dtile)
+            if jnp.iscomplexobj(dtile):
+                ddiag = jnp.real(ddiag).astype(dt)
+            dtile = (dlow + jnp.conj(dlow).T).at[idx, idx].set(ddiag)
+            lkk_aug = potrf_tile(dtile + pad_eye)
+            lkk_aug = faults.maybe_corrupt("post_panel", lkk_aug)
+            if abft:
+                lkk_aug, det, cor = _abft.chol_tile_check(
+                    dtile + pad_eye, lkk_aug, n_ctx=n)
+                ev = _abft.count_event(det, cor, kn, kn)
+                rep = (rep[0] + jnp.where(live, ev.detected, 0),
+                       rep[1] + jnp.where(live, ev.corrected, 0),
+                       jnp.where(rep[2] >= 0, rep[2],
+                                 jnp.where(live, ev.site, neg1)))
+            lkk = jnp.where(vmask, lkk_aug, jnp.zeros_like(lkk_aug))
 
-            def trailing(args):
-                a_loc, loc = args
-                sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
-                sc = jnp.clip(-(-(k0 - c) // q), 0, ntl - T).astype(jnp.int32)
-                gi = r + p * (sr + jnp.arange(S))
-                gj = c + q * (sc + jnp.arange(T))
-                prow = gpan[gi]                   # [S, nb, nb]
-                pcol = gpan[gj]                   # [T, nb, nb]
-                with span("slate.potrf/herk"):
-                    upd = herk_panel_update(prow, pcol)
-                cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
-                                        (S, T, nb, nb))
-                mask = ((gi > k)[:, None, None, None] &
-                        (gj > k)[None, :, None, None])
-                new = cur - upd
-                if abft:
-                    # per-tile checksum maintenance of the rank-local
-                    # herk (dead tiles have zero gpan entries, so their
-                    # expectation collapses to cur's own sums)
-                    pch = jnp.conj(pcol).transpose(0, 2, 1)
-                    exp_r = (jnp.sum(cur, axis=3)
-                             - _abft.tile_product_row_sums(
-                                 prow[:, None], pch[None]))
-                    exp_c = (jnp.sum(cur, axis=2)
-                             - _abft.tile_product_col_sums(
-                                 prow[:, None], pch[None]))
-                    new, ev, ti_l, tj_l = _abft.tile_sum_check(
-                        new, exp_r, exp_c, n_ctx=n)
-                    s = jnp.where(ev.detected > 0,
-                                  _abft.site_code(gi[ti_l], gj[tj_l]),
-                                  neg1)
-                    loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
-                           jnp.where(loc[2] >= 0, loc[2], s))
-                new = jnp.where(mask, new, cur)
-                return lax.dynamic_update_slice(a_loc, new,
-                                                (sr, sc, zi, zi)), loc
+            d = jnp.abs(jnp.diagonal(lkk_aug))
+            d = jnp.where(jnp.isnan(d), jnp.zeros_like(d), d)
+            d = jnp.where(idx < vk, d, jnp.full_like(d, jnp.inf))
+            j = jnp.argmin(d).astype(jnp.int32)
+            upd = (d[j] < minpiv) & live
+            minpiv = jnp.where(upd, d[j], minpiv)
+            minidx = jnp.where(upd, (kn * nb + j).astype(jnp.int32), minidx)
 
-            a_loc, loc = lax.cond(k < Nt - 1, trailing, lambda x: x,
-                                  (a_loc, loc))
-            return a_loc, minpiv, minidx, rep, loc
+            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+            sol = trsm_tile_batch(lkk_aug, pan, left=False, lower=True,
+                                  op_tri=Op.ConjTrans)
+            keep = (gi_all[:, None, None] <= kn)
+            newcol = jnp.where(keep, pan, sol)
+            newcol = jnp.where((gi_all == kn)[:, None, None], lkk, newcol)
+            col_sel = jnp.where(live & (c == ck), newcol, pan)
+            a_loc = lax.dynamic_update_slice(
+                a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
 
-        if S <= 0 or T <= 0:
-            # no rank has trailing tiles only when k0 >= Nt (cannot happen)
-            continue
-        a_loc, minpiv, minidx, rep, loc = lax.fori_loop(
-            k0, k1, super_step, (a_loc, minpiv, minidx, rep, loc))
+        with span("slate.potrf/bcast_ahead"):
+            contrib = jnp.where((gi_all > kn)[:, None, None], sol,
+                                jnp.zeros_like(sol))
+            if abft:
+                augl = jnp.zeros((mtl, nb + 1, nb + 1), dt)
+                augl = augl.at[:, :nb, :nb].set(contrib)
+                rmask = (gi_all > kn)[:, None]
+                augl = augl.at[:, :nb, nb].set(
+                    jnp.where(rmask, jnp.sum(pan, axis=2), 0))
+                augl = augl.at[:, nb, :nb].set(
+                    jnp.where(rmask, jnp.sum(pan, axis=1), 0))
+                buf = jnp.zeros((p * mtl, nb + 1, nb + 1), dt)
+                buf = buf.at[gi_all].set(augl)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+                # the p-axis combine is a scatter-merge of disjoint row
+                # slots (not single-root), so it stays a psum; the q-axis
+                # broadcast from the owner column becomes the ring
+                aug = lax.psum(buf, AXIS_P)
+                aug = ring_bcast_from_col(aug, ck, q)
+                gpan = faults.maybe_corrupt("post_collective",
+                                            aug[:, :nb, :nb])
+                r_row = jnp.conj(aug[:, nb, :nb])  # (R^H) e = conj(e^T R)
+                r_col = jnp.conj(aug[:, :nb, nb])  # e^T R^H = conj(R e)
+                xh, det_t, cor_t, _, _ = jax.vmap(
+                    lambda xx, rr, cc: _abft.left_product_check(
+                        lkk_aug, jnp.conj(xx).T, rr, cc,
+                        unit=False, n_ctx=n))(gpan, r_row, r_col)
+                gpan = jnp.conj(xh).transpose(0, 2, 1)
+                trail = jnp.arange(p * mtl) > kn
+                det_n = jnp.where(live, jnp.sum(trail & det_t,
+                                                dtype=jnp.int32), 0)
+                cor_n = jnp.where(live, jnp.sum(trail & cor_t,
+                                                dtype=jnp.int32), 0)
+                ti_g = jnp.argmax(trail & det_t).astype(jnp.int32)
+                s = jnp.where(det_n > 0, _abft.site_code(ti_g, kn), neg1)
+                rep = (rep[0] + det_n, rep[1] + cor_n,
+                       jnp.where(rep[2] >= 0, rep[2], s))
+            else:
+                buf = jnp.zeros((p * mtl, nb, nb), dt)
+                buf = buf.at[gi_all].set(contrib)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+                gpan = lax.psum(buf, AXIS_P)
+                gpan = ring_bcast_from_col(gpan, ck, q)
+                gpan = faults.maybe_corrupt("post_collective", gpan)
+        return a_loc, minpiv, minidx, rep, gpan
+
+    def early_update(k, a_loc, loc, gpan):
+        """Priority herk (SLATE's lookahead tasks, potrf.cc:266-287):
+        apply step k's panel to columns k+1..k+la only, so ``issue`` can
+        factor the next panel before the late trailing update runs."""
+        prow = gpan[gi_all]                        # [mtl, nb, nb]
+        for dcol in range(1, la + 1):
+            cd = jnp.minimum(k + dcol, Nt - 1)
+            livec = k + dcol < Nt
+            slot = (cd // q).astype(jnp.int32)
+            pcol = gpan[cd][None]                  # [1, nb, nb]
+            with span("slate.potrf/herk"):
+                upd = herk_panel_update(prow, pcol)   # [mtl, 1, nb, nb]
+            cur = lax.dynamic_slice(a_loc, (zi, slot, zi, zi),
+                                    (mtl, 1, nb, nb))
+            mask = ((gi_all > k)[:, None, None, None] & livec &
+                    (c == cd % q))
+            new = cur - upd
+            if abft:
+                pch = jnp.conj(pcol).transpose(0, 2, 1)
+                exp_r = (jnp.sum(cur, axis=3)
+                         - _abft.tile_product_row_sums(
+                             prow[:, None], pch[None]))
+                exp_c = (jnp.sum(cur, axis=2)
+                         - _abft.tile_product_col_sums(
+                             prow[:, None], pch[None]))
+                new, ev, ti_l, tj_l = _abft.tile_sum_check(
+                    new, exp_r, exp_c, n_ctx=n)
+                s = jnp.where((ev.detected > 0) & livec,
+                              _abft.site_code(gi_all[ti_l], cd), neg1)
+                loc = (loc[0] + jnp.where(livec, ev.detected, 0),
+                       loc[1] + jnp.where(livec, ev.corrected, 0),
+                       jnp.where(loc[2] >= 0, loc[2], s))
+            new = jnp.where(mask, new, cur)
+            a_loc = lax.dynamic_update_slice(a_loc, new,
+                                             (zi, slot, zi, zi))
+        return a_loc, loc
+
+    def trailing_update(k, a_loc, loc, gpan, k0, S, T, gj_min):
+        """Trailing herk of step k over this superblock's static [S, T]
+        window, restricted to columns gj > gj_min (k at depth 0; k + la
+        in the pipeline, whose priority phase already did the rest).
+        Per-tile-independent einsums, so splitting the column range
+        across phases is bit-exact.  Storage pad columns (gj >= Nt, on
+        grids where ntl * q > Nt) are always late: the priority phase
+        clamps its targets to real columns, so without this the junk
+        tiles would see a different update count than depth 0 and the
+        bit-exact storage parity between depths would break."""
+        sr = jnp.clip(-(-(k0 - r) // p), 0, mtl - S).astype(jnp.int32)
+        sc = jnp.clip(-(-(k0 - c) // q), 0, ntl - T).astype(jnp.int32)
+        gi = r + p * (sr + jnp.arange(S))
+        gj = c + q * (sc + jnp.arange(T))
+        prow = gpan[gi]                   # [S, nb, nb]
+        pcol = gpan[gj]                   # [T, nb, nb]
+        with span("slate.potrf/herk"):
+            upd = herk_panel_update(prow, pcol)
+        cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
+                                (S, T, nb, nb))
+        mask = ((gi > k)[:, None, None, None] &
+                ((gj > gj_min) | (gj >= Nt))[None, :, None, None])
+        new = cur - upd
+        if abft:
+            # per-tile checksum maintenance of the rank-local
+            # herk (dead tiles have zero gpan entries, so their
+            # expectation collapses to cur's own sums)
+            pch = jnp.conj(pcol).transpose(0, 2, 1)
+            exp_r = (jnp.sum(cur, axis=3)
+                     - _abft.tile_product_row_sums(
+                         prow[:, None], pch[None]))
+            exp_c = (jnp.sum(cur, axis=2)
+                     - _abft.tile_product_col_sums(
+                         prow[:, None], pch[None]))
+            new, ev, ti_l, tj_l = _abft.tile_sum_check(
+                new, exp_r, exp_c, n_ctx=n)
+            s = jnp.where(ev.detected > 0,
+                          _abft.site_code(gi[ti_l], gj[tj_l]),
+                          neg1)
+            loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
+                   jnp.where(loc[2] >= 0, loc[2], s))
+        new = jnp.where(mask, new, cur)
+        return lax.dynamic_update_slice(a_loc, new, (sr, sc, zi, zi)), loc
+
+    if la == 0:
+        for k0 in range(0, Nt, sb):
+            k1 = min(k0 + sb, Nt)
+            # static trailing window (max over ranks) for this superblock:
+            # local slots whose global index can be >= k0
+            S = mtl - (k0 // p)
+            T = ntl - (k0 // q)
+
+            def super_step(k, carry, k0=k0, S=S, T=T):
+                (a_loc, minpiv, minidx, rep, loc), gpan = step(k, carry)
+                a_loc, loc = lax.cond(
+                    k < Nt - 1,
+                    lambda args: trailing_update(k, args[0], args[1], gpan,
+                                                 k0, S, T, k),
+                    lambda args: args, (a_loc, loc))
+                return a_loc, minpiv, minidx, rep, loc
+
+            if S <= 0 or T <= 0:
+                # no rank has trailing tiles only when k0 >= Nt
+                continue
+            a_loc, minpiv, minidx, rep, loc = lax.fori_loop(
+                k0, k1, super_step, (a_loc, minpiv, minidx, rep, loc))
+    else:
+        a_loc, minpiv, minidx, rep, gpan = issue(
+            0, a_loc, minpiv, minidx, rep, jnp.asarray(True))
+        for k0 in range(0, Nt, sb):
+            k1 = min(k0 + sb, Nt)
+            S = mtl - (k0 // p)
+            T = ntl - (k0 // q)
+
+            def super_pipe(k, carry, k0=k0, S=S, T=T):
+                a_loc, minpiv, minidx, rep, loc, gpan = carry
+                # (1) priority phase: columns k+1..k+la get step k's herk
+                a_loc, loc = early_update(k, a_loc, loc, gpan)
+                # (2) issue panel k+1 — its ring broadcast is in flight
+                #     while (3) runs, which is the whole point
+                a_loc, minpiv, minidx, rep, gpan_next = issue(
+                    jnp.minimum(k + 1, Nt - 1), a_loc, minpiv, minidx,
+                    rep, k + 1 < Nt)
+                # (3) late trailing update of step k (columns > k+la)
+                a_loc, loc = lax.cond(
+                    k < Nt - 1,
+                    lambda args: trailing_update(k, args[0], args[1], gpan,
+                                                 k0, S, T, k + la),
+                    lambda args: args, (a_loc, loc))
+                return a_loc, minpiv, minidx, rep, loc, gpan_next
+
+            if S <= 0 or T <= 0:
+                continue
+            a_loc, minpiv, minidx, rep, loc, gpan = lax.fori_loop(
+                k0, k1, super_pipe,
+                (a_loc, minpiv, minidx, rep, loc, gpan))
 
     ldet = lax.psum(lax.psum(loc[0], AXIS_P), AXIS_Q)
     lcor = lax.psum(lax.psum(loc[1], AXIS_P), AXIS_Q)
@@ -259,7 +461,8 @@ def _potrf_local(a_loc, Nt: int, n: int, p: int, q: int, mtl: int, ntl: int,
 
 
 def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
-               sb: int | None = None, abft: bool = False):
+               sb: int | None = None, abft: bool = False,
+               la: int | None = None):
     """Factor the cyclic storage array of a Hermitian (lower) matrix in
     place: lower tiles of the result hold L.  ``n`` is the element dimension
     (for ragged last tiles); defaults to Nt*nb (exact tiling).  ``sb`` is
@@ -272,16 +475,23 @@ def dist_potrf(data, Nt: int, grid: Grid, n: int | None = None,
     minor — is recorded as a zero pivot).  ``abft`` (static) turns on
     Huang-Abraham checksum verification of the diagonal factor, the
     broadcast panel and the trailing herk (robust/abft.py); the three
-    trailing int32 scalars are zero / -1 when off or clean."""
+    trailing int32 scalars are zero / -1 when off or clean.
+
+    ``la`` is the comm/compute lookahead depth (see _potrf_local); None
+    resolves the tuned depth through the ``dist_lookahead`` plan
+    (SEAM011 — untuned chips stay on the depth-0 oracle)."""
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     nb = data.shape[-1]
     n = n if n is not None else Nt * nb
     sb = sb if sb is not None else superblock(Nt)
+    if la is None:
+        from ..tune import lookahead_depth
+        la = lookahead_depth(n, data.dtype.name)
     spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _potrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl, sb,
-                               abft),
+                               abft, la),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P(), P(), P(), P(), P()))
     return fn(data)

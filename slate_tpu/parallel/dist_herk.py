@@ -29,6 +29,7 @@ from jax import lax
 from ..comm.collectives import bcast_from_col
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.compat_jax import pvary, shard_map_unchecked
+from ..util.trace import span
 
 
 def _pair_budget(Mt: int, Nt: int, p: int, q: int, mtl: int, ntl: int,
@@ -95,11 +96,12 @@ def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
         nb = c_loc.shape[-1]
 
         def panel(k, data):
-            pan = lax.dynamic_index_in_dim(data, k // q, axis=1,
-                                           keepdims=False)
-            pan = bcast_from_col(pan, k % q)     # [mtl, nb, kb] my rows
-            cols = _gather_panel_rows(pan, gj, p)  # [ntl, nb, kb] my cols
-            return pan, cols
+            with span("slate.herk/bcast"):
+                pan = lax.dynamic_index_in_dim(data, k // q, axis=1,
+                                               keepdims=False)
+                pan = bcast_from_col(pan, k % q)   # [mtl, nb, kb] my rows
+                cols = _gather_panel_rows(pan, gj, p)  # [ntl] my cols
+                return pan, cols
 
         def pair_update(rows, cols):
             rg = jnp.take(rows, il, axis=0)      # [S, nb, kb]
@@ -112,10 +114,12 @@ def dist_herk_data(a_data, c_data, alpha, beta, Kt: int, Mt: int, Nt: int,
             arow, acol = panel(k, a_loc)
             if two_k:
                 brow, bcol = panel(k, maybe_b[0])
-                upd = (jnp.asarray(alpha, dt) * pair_update(arow, bcol) +
-                       jnp.asarray(a2, dt) * pair_update(brow, acol))
+                with span("slate.herk/update"):
+                    upd = (jnp.asarray(alpha, dt) * pair_update(arow, bcol)
+                           + jnp.asarray(a2, dt) * pair_update(brow, acol))
             else:
-                upd = jnp.asarray(alpha, dt) * pair_update(arow, acol)
+                with span("slate.herk/update"):
+                    upd = jnp.asarray(alpha, dt) * pair_update(arow, acol)
             return acc + upd
 
         acc0 = pvary(jnp.zeros((S, nb, nb), dt), (AXIS_P, AXIS_Q))
@@ -185,20 +189,22 @@ def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
                     (AXIS_P, AXIS_Q))
 
         def panel_k(k, a_loc, b_loc):
-            # A tile column k -> all mesh columns (listBcast of the panel)
-            pan = lax.dynamic_index_in_dim(a_loc, k // q, axis=1,
-                                           keepdims=False)
-            pan = bcast_from_col(pan, k % q)     # [mtl, nb, nb] my rows
-            pan = _tri_mask_tile(
-                pan, gi_all == k,
-                (gi_all > k) if lower else (gi_all < k), lower, unit_diag)
-            # B tile row k -> all mesh rows
-            row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0,
-                                           keepdims=False)
-            me = lax.axis_index(AXIS_P)
-            row = jnp.where(me == k % p, row, jnp.zeros_like(row))
-            row = lax.psum(row, AXIS_P)          # [ntl, nb, cb]
-            return pan, row
+            with span("slate.trmm/bcast"):
+                # A tile column k -> all mesh columns (panel listBcast)
+                pan = lax.dynamic_index_in_dim(a_loc, k // q, axis=1,
+                                               keepdims=False)
+                pan = bcast_from_col(pan, k % q)   # [mtl, nb, nb] my rows
+                pan = _tri_mask_tile(
+                    pan, gi_all == k,
+                    (gi_all > k) if lower else (gi_all < k), lower,
+                    unit_diag)
+                # B tile row k -> all mesh rows
+                row = lax.dynamic_index_in_dim(b_loc, k // p, axis=0,
+                                               keepdims=False)
+                me = lax.axis_index(AXIS_P)
+                row = jnp.where(me == k % p, row, jnp.zeros_like(row))
+                row = lax.psum(row, AXIS_P)      # [ntl, nb, cb]
+                return pan, row
 
         for k0 in range(0, Kt, sb):
             k1 = min(k0 + sb, Kt)
@@ -209,18 +215,19 @@ def dist_trmm_data(a_data, b_data, alpha, Kt: int, Mt: int, grid: Grid,
 
             def super_step(k, acc, S=S, k0=k0):
                 pan, row = panel_k(k, a_loc, b_loc)
-                if lower:
-                    sr = jnp.clip(-(-(k0 - r) // p), 0,
-                                  mtl - S).astype(jnp.int32)
-                else:
-                    sr = zi
-                pwin = lax.dynamic_slice(pan, (sr, zi, zi), (S, nb, nb))
-                upd = jnp.einsum("iab,jbc->ijac", pwin, row,
-                                 preferred_element_type=dt)
-                cur = lax.dynamic_slice(acc, (sr, zi, zi, zi),
-                                        (S, ntl, nb, cb))
-                return lax.dynamic_update_slice(acc, cur + upd,
-                                                (sr, zi, zi, zi))
+                with span("slate.trmm/update"):
+                    if lower:
+                        sr = jnp.clip(-(-(k0 - r) // p), 0,
+                                      mtl - S).astype(jnp.int32)
+                    else:
+                        sr = zi
+                    pwin = lax.dynamic_slice(pan, (sr, zi, zi), (S, nb, nb))
+                    upd = jnp.einsum("iab,jbc->ijac", pwin, row,
+                                     preferred_element_type=dt)
+                    cur = lax.dynamic_slice(acc, (sr, zi, zi, zi),
+                                            (S, ntl, nb, cb))
+                    return lax.dynamic_update_slice(acc, cur + upd,
+                                                    (sr, zi, zi, zi))
 
             if S > 0:
                 acc = lax.fori_loop(k0, k1, super_step, acc)
@@ -256,21 +263,23 @@ def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
                     (AXIS_P, AXIS_Q))
 
         def panel_k(k, a_loc, b_loc):
-            # A tile row k -> all mesh rows
-            arow = lax.dynamic_index_in_dim(a_loc, k // p, axis=0,
-                                            keepdims=False)
-            me = lax.axis_index(AXIS_P)
-            arow = jnp.where(me == k % p, arow, jnp.zeros_like(arow))
-            arow = lax.psum(arow, AXIS_P)        # [ntl, nb, nb] my cols
-            # A[k, j] is full for j < k (lower) / j > k (upper)
-            arow = _tri_mask_tile(
-                arow, gj_all == k,
-                (gj_all < k) if lower else (gj_all > k), lower, unit_diag)
-            # B tile column k -> all mesh columns
-            bcol = lax.dynamic_index_in_dim(b_loc, k // q, axis=1,
-                                            keepdims=False)
-            bcol = bcast_from_col(bcol, k % q)   # [mtl, cb, nb]
-            return arow, bcol
+            with span("slate.trmm/bcast"):
+                # A tile row k -> all mesh rows
+                arow = lax.dynamic_index_in_dim(a_loc, k // p, axis=0,
+                                                keepdims=False)
+                me = lax.axis_index(AXIS_P)
+                arow = jnp.where(me == k % p, arow, jnp.zeros_like(arow))
+                arow = lax.psum(arow, AXIS_P)    # [ntl, nb, nb] my cols
+                # A[k, j] is full for j < k (lower) / j > k (upper)
+                arow = _tri_mask_tile(
+                    arow, gj_all == k,
+                    (gj_all < k) if lower else (gj_all > k), lower,
+                    unit_diag)
+                # B tile column k -> all mesh columns
+                bcol = lax.dynamic_index_in_dim(b_loc, k // q, axis=1,
+                                                keepdims=False)
+                bcol = bcast_from_col(bcol, k % q)   # [mtl, cb, nb]
+                return arow, bcol
 
         for k0 in range(0, Kt, sb):
             k1 = min(k0 + sb, Kt)
@@ -281,18 +290,20 @@ def dist_trmm_right_data(a_data, b_data, alpha, Kt: int, Nt: int,
 
             def super_step(k, acc, T=T, k0=k0):
                 arow, bcol = panel_k(k, a_loc, b_loc)
-                if lower:
-                    sc = zi
-                else:
-                    sc = jnp.clip(-(-(k0 - c) // q), 0,
-                                  ntl - T).astype(jnp.int32)
-                awin = lax.dynamic_slice(arow, (sc, zi, zi), (T, nb, nb))
-                upd = jnp.einsum("iab,jbc->ijac", bcol, awin,
-                                 preferred_element_type=dt)
-                cur = lax.dynamic_slice(acc, (zi, sc, zi, zi),
-                                        (mtl, T, cb, nb))
-                return lax.dynamic_update_slice(acc, cur + upd,
-                                                (zi, sc, zi, zi))
+                with span("slate.trmm/update"):
+                    if lower:
+                        sc = zi
+                    else:
+                        sc = jnp.clip(-(-(k0 - c) // q), 0,
+                                      ntl - T).astype(jnp.int32)
+                    awin = lax.dynamic_slice(arow, (sc, zi, zi),
+                                             (T, nb, nb))
+                    upd = jnp.einsum("iab,jbc->ijac", bcol, awin,
+                                     preferred_element_type=dt)
+                    cur = lax.dynamic_slice(acc, (zi, sc, zi, zi),
+                                            (mtl, T, cb, nb))
+                    return lax.dynamic_update_slice(acc, cur + upd,
+                                                    (zi, sc, zi, zi))
 
             if T > 0:
                 acc = lax.fori_loop(k0, k1, super_step, acc)

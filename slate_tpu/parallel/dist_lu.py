@@ -45,6 +45,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..comm.collectives import ring_bcast_from_col
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.getrf import (panel_lu, panel_lu_nopiv, panel_lu_threshold,
                               panel_lu_tournament)
@@ -66,6 +67,26 @@ def _gather_panel(a_loc, k, p, q, mtl, r, c):
     buf = buf.at[gi_all].set(pan)
     buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
     return lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+
+
+def _gather_panel_ring(a_loc, k, p, q, mtl, r, c):
+    """Ring variant of :func:`_gather_panel` for the lookahead pipeline.
+
+    The p-axis merge stays a psum (disjoint row slots scatter-merge, not
+    a broadcast), but the q-axis owner-column replication becomes a
+    ppermute ring so the next panel's hops can slide underneath the
+    trailing einsum that runs between issue and consumption.  Pure data
+    movement — bit-identical to the psum-masked gather."""
+    nb = a_loc.shape[-1]
+    kkc = k // q
+    ck = k % q
+    pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+    gi_all = r + p * jnp.arange(mtl)
+    buf = jnp.zeros((p * mtl, nb, nb), a_loc.dtype)
+    buf = buf.at[gi_all].set(pan)
+    buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+    buf = lax.psum(buf, AXIS_P)
+    return ring_bcast_from_col(buf, ck, q)
 
 
 def _row_bundle_exchange(a_loc, out_rows, in_rows, p, r, nbundle):
@@ -103,7 +124,7 @@ def _row_bundle_exchange(a_loc, out_rows, in_rows, p, r, nbundle):
 
 def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                       ib: int, sb: int, tau: float = 1.0, mpt: int = 4,
-                      depth: int = 2, abft: bool = False):
+                      depth: int = 2, abft: bool = False, la: int = 0):
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
@@ -131,6 +152,17 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
     rep = (zi, zi, neg1)
     loc = (zi, zi, neg1)
 
+    # Lookahead prologue: panel 0's gather is already in flight (carried as
+    # G) when the first step starts; each step then issues step k+1's ring
+    # gather before its late trailing update so the broadcast rides under
+    # the einsum (ref getrf.cc lookahead task priorities).  G is the full
+    # [p*mtl, nb, nb] pre-factor column — superblock-independent shape, so
+    # it crosses superblock boundaries; the window slice happens at
+    # consumption with the consuming superblock's static bounds.
+    if la > 0:
+        with span("slate.getrf/bcast_ahead"):
+            G = _gather_panel_ring(a_loc, 0, p, q, mtl, r, c)
+
     for k0 in range(0, Nt, sb):
         k1 = min(k0 + sb, Nt)
         W0 = Nt - k0                             # panel tiles this superblock
@@ -141,14 +173,20 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
 
         def super_step(k, carry, W0=W0, W=W, nbundle=nbundle, S=S, T=T,
                        k0=k0):
-            a_loc, perm_g, minpiv, minidx, rep, loc = carry
+            if la == 0:
+                a_loc, perm_g, minpiv, minidx, rep, loc = carry
+            else:
+                a_loc, perm_g, minpiv, minidx, rep, loc, G = carry
             rk, ck = k % p, k % q
             kkr = k // p
             vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
 
-            # ---- gather + factor the panel (replicated) ----
+            # ---- gather + factor the panel (replicated).  At la >= 1 the
+            #      gather already happened at the previous step (carried in
+            #      G, issued before that step's late trailing update) ----
             with span("slate.getrf/panel"):
-                gpan = _gather_panel(a_loc, k, p, q, mtl, r, c)
+                gpan = (_gather_panel(a_loc, k, p, q, mtl, r, c)
+                        if la == 0 else G)
                 panel = gpan[k0:Nt].reshape(W, nb)   # static slice
                 # roll active rows (>= k) to the top, zero the factored
                 # tail
@@ -223,8 +261,7 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                 a_loc, col_sel[:, None],
                 (zi, (k // q).astype(jnp.int32), zi, zi))
 
-            def tail(carry):
-                a_loc, perm_g, loc = carry
+            def solve_u12(a_loc, loc):
                 # ---- U12: row-k owners solve vs unit-lower L11, bcast ----
                 with span("slate.getrf/trsm"):
                     l11 = lut[0]
@@ -272,8 +309,59 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                     row_sel = jnp.where(r == rk, newrow, urow)
                     a_loc = lax.dynamic_update_slice(
                         a_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
+                return a_loc, loc, u12
 
-                # ---- trailing update on the static-size slice ----
+            def early_cols(a_loc, loc, u12):
+                # ---- lookahead priority columns k+1 .. k+la: update them
+                #      FIRST so the next panel gather (issued before the
+                #      late trailing update below) reads finished tiles.
+                #      Each rank's u12 slot cd//q holds column cd's solved
+                #      tile exactly on the owner column cd % q; elsewhere
+                #      (and on dead steps near the edge) the operand is
+                #      zeroed, so the ABFT expectation collapses to cur's
+                #      own sums and the check is clean by construction ----
+                for dcol in range(1, la + 1):
+                    cd = jnp.minimum(k + dcol, Nt - 1)
+                    act = (k + dcol < Nt) & (c == cd % q)
+                    slot = (cd // q).astype(jnp.int32)
+                    lrows_e = jnp.take(lut, jnp.clip(gi_all - k, 0, W0 - 1),
+                                       axis=0)
+                    lrows_e = jnp.where((gi_all > k)[:, None, None], lrows_e,
+                                        jnp.zeros_like(lrows_e))
+                    ucol = lax.dynamic_index_in_dim(u12, slot, axis=0,
+                                                    keepdims=False)[None]
+                    ucol = jnp.where(act, ucol, jnp.zeros_like(ucol))
+                    upd = jnp.einsum("iab,jbc->ijac", lrows_e, ucol,
+                                     preferred_element_type=dt)
+                    cur = lax.dynamic_slice(a_loc, (zi, slot, zi, zi),
+                                            (mtl, 1, nb, nb))
+                    mask = (gi_all > k)[:, None, None, None] & act
+                    new = cur - upd
+                    if abft:
+                        exp_r = (jnp.sum(cur, axis=3)
+                                 - _abft.tile_product_row_sums(
+                                     lrows_e[:, None], ucol[None]))
+                        exp_c = (jnp.sum(cur, axis=2)
+                                 - _abft.tile_product_col_sums(
+                                     lrows_e[:, None], ucol[None]))
+                        new, ev, ti_l, _ = _abft.tile_sum_check(
+                            new, exp_r, exp_c, n_ctx=n)
+                        s = jnp.where(ev.detected > 0,
+                                      _abft.site_code(gi_all[ti_l], cd),
+                                      jnp.asarray(-1, jnp.int32))
+                        loc = (loc[0] + ev.detected, loc[1] + ev.corrected,
+                               jnp.where(loc[2] >= 0, loc[2], s))
+                    a_loc = lax.dynamic_update_slice(
+                        a_loc, jnp.where(mask, new, cur), (zi, slot, zi, zi))
+                return a_loc, loc
+
+            def late_gemm(a_loc, loc, u12, gj_min):
+                # ---- trailing update on the static-size slice (columns
+                #      > gj_min; gj_min = k at depth 0, k+la pipelined).
+                #      Storage pad columns (gj >= Nt) are always late:
+                #      early_cols clamps to real columns, so the junk
+                #      tiles must follow the depth-0 schedule here or
+                #      bit-exact storage parity between depths breaks ----
                 with span("slate.getrf/gemm"):
                     sr = jnp.clip(-(-(k0 + 1 - r) // p), 0,
                                   mtl - S).astype(jnp.int32)
@@ -285,14 +373,14 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                     lrows = jnp.where((gi > k)[:, None, None], lrows,
                                       jnp.zeros_like(lrows))
                     ucols = lax.dynamic_slice(u12, (sc, zi, zi), (T, nb, nb))
-                    ucols = jnp.where((gj > k)[:, None, None], ucols,
-                                      jnp.zeros_like(ucols))
+                    ucols = jnp.where(((gj > gj_min) | (gj >= Nt))[:, None, None],
+                                      ucols, jnp.zeros_like(ucols))
                     upd = jnp.einsum("iab,jbc->ijac", lrows, ucols,
                                      preferred_element_type=dt)
                     cur = lax.dynamic_slice(a_loc, (sr, sc, zi, zi),
                                             (S, T, nb, nb))
                     mask = ((gi > k)[:, None, None, None] &
-                            (gj > k)[None, :, None, None])
+                            ((gj > gj_min) | (gj >= Nt))[None, :, None, None])
                     new = cur - upd
                     if abft:
                         # per-tile checksum maintenance of the rank-local
@@ -314,17 +402,58 @@ def _dist_getrf_local(a_loc, Nt, n, p, q, mtl, ntl, method: str,
                                jnp.where(loc[2] >= 0, loc[2], s))
                     a_loc = lax.dynamic_update_slice(
                         a_loc, jnp.where(mask, new, cur), (sr, sc, zi, zi))
-                return a_loc, perm_g, loc
+                return a_loc, loc
 
+            if la == 0:
+                def tail(cr):
+                    a_loc, perm_g, loc = cr
+                    a_loc, loc, u12 = solve_u12(a_loc, loc)
+                    a_loc, loc = late_gemm(a_loc, loc, u12, k)
+                    return a_loc, perm_g, loc
+
+                if S > 0 and T > 0:
+                    # slate-lint: disable=COL003,COL005 -- k is the replicated fori_loop index and Nt is static: every rank evaluates the same predicate, so the psum branch is taken mesh-uniformly
+                    a_loc, perm_g, loc = lax.cond(k < Nt - 1, tail,
+                                                  lambda cr: cr,
+                                                  (a_loc, perm_g, loc))
+                return a_loc, perm_g, minpiv, minidx, rep, loc
+
+            # ---- la >= 1 pipeline: solve U12 + finish the priority
+            #      columns, issue step k+1's panel gather, THEN run the
+            #      late trailing update (columns > k+la) so the ring hops
+            #      overlap the big einsum.  The final step's issue is
+            #      clamped to column Nt-1 (already factored, pure read)
+            #      and its result dies with the dropped carry ----
+            def head(cr):
+                a_loc, loc, u12 = cr
+                a_loc, loc, u12 = solve_u12(a_loc, loc)
+                a_loc, loc = early_cols(a_loc, loc, u12)
+                return a_loc, loc, u12
+
+            u12 = jnp.zeros((ntl, nb, nb), dt)
             if S > 0 and T > 0:
                 # slate-lint: disable=COL003,COL005 -- k is the replicated fori_loop index and Nt is static: every rank evaluates the same predicate, so the psum branch is taken mesh-uniformly
-                a_loc, perm_g, loc = lax.cond(k < Nt - 1, tail,
-                                              lambda cr: cr,
-                                              (a_loc, perm_g, loc))
-            return a_loc, perm_g, minpiv, minidx, rep, loc
+                a_loc, loc, u12 = lax.cond(k < Nt - 1, head,
+                                           lambda cr: cr,
+                                           (a_loc, loc, u12))
+            with span("slate.getrf/bcast_ahead"):
+                G = _gather_panel_ring(a_loc, jnp.minimum(k + 1, Nt - 1),
+                                       p, q, mtl, r, c)
+            if S > 0 and T > 0:
+                a_loc, loc = lax.cond(
+                    k < Nt - 1,
+                    lambda cr: late_gemm(cr[0], cr[1], u12, k + la),
+                    lambda cr: cr, (a_loc, loc))
+            return a_loc, perm_g, minpiv, minidx, rep, loc, G
 
-        a_loc, perm_g, minpiv, minidx, rep, loc = lax.fori_loop(
-            k0, k1, super_step, (a_loc, perm_g, minpiv, minidx, rep, loc))
+        carry = (a_loc, perm_g, minpiv, minidx, rep, loc)
+        if la > 0:
+            carry = carry + (G,)
+        carry = lax.fori_loop(k0, k1, super_step, carry)
+        if la > 0:
+            a_loc, perm_g, minpiv, minidx, rep, loc, G = carry
+        else:
+            a_loc, perm_g, minpiv, minidx, rep, loc = carry
 
     ldet = lax.psum(lax.psum(loc[0], AXIS_P), AXIS_Q)
     lcor = lax.psum(lax.psum(loc[1], AXIS_P), AXIS_Q)
@@ -440,7 +569,8 @@ def dist_rbt_two_sided(data, u_levels, v_levels, grid: Grid, n: int):
 
 def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
                ib: int = 16, sb: int | None = None, tau: float = 1.0,
-               mpt: int = 4, depth: int = 2, abft: bool = False):
+               mpt: int = 4, depth: int = 2, abft: bool = False,
+               la: int | None = None):
     """Factor square cyclic storage in place; returns
     (data, perm, minpiv, minidx, abft_detected, abft_corrected,
     abft_site) with A[perm] = L @ U (perm over the padded row space,
@@ -455,14 +585,25 @@ def dist_getrf(data, Nt: int, grid: Grid, n: int, method: str = "partial",
 
     ``tau`` (Option.PivotThreshold) < 1 switches the partial-pivot panel to
     threshold pivoting; ``mpt`` (Option.MaxPanelThreads) sizes the CALU
-    tournament row blocks; ``depth`` (Option.Depth) its tree fan-in."""
+    tournament row blocks; ``depth`` (Option.Depth) its tree fan-in.
+
+    ``la`` (0/1/2, static) is the lookahead pipeline depth — NOT the CALU
+    ``depth`` above: at la >= 1 each step rings the NEXT panel's gather
+    ahead of its late trailing update (and finishes columns k+1..k+la
+    first so the gather reads complete tiles).  Bit-identical to la=0.
+    None resolves the tuned depth via the ``dist_lookahead`` plan
+    (SEAM011)."""
+    if la is None:
+        from ..tune import lookahead_depth
+        la = lookahead_depth(n, data.dtype.name)
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     sb = sb if sb is not None else superblock(Nt)
     spec = TILE_SPEC
     fn = shard_map_unchecked(
         lambda a: _dist_getrf_local(a, Nt, n, grid.p, grid.q, mtl, ntl,
-                                    method, ib, sb, tau, mpt, depth, abft),
+                                    method, ib, sb, tau, mpt, depth, abft,
+                                    la=la),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P(), P(), P(), P(), P(), P()))
     return fn(data)

@@ -35,7 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..comm.collectives import bcast_along
+from ..comm.collectives import bcast_along, ring_bcast_from_col
 from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..util.trace import span
 from ..util.compat_jax import pvary, shard_map_unchecked
@@ -119,10 +119,18 @@ def _all_panel_tables(Kt: int, Mt: int, m: int, nb: int, p: int):
     return jnp.asarray(skips), jnp.asarray(poss)
 
 
-def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
+def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl, la: int = 0):
     """ONE lax.fori_loop over the Kt panels (per-step shapes are
     k-independent, so no superblocking is needed — the compiled program is
-    O(1) in Kt)."""
+    O(1) in Kt).
+
+    ``la`` (0/1/2, static) is the lookahead pipeline depth: at la >= 1 the
+    carry holds the NEXT panel's local QR + ring-broadcast result, issued
+    after the early update of columns k+1..k+la and before the late
+    trailing update (columns > k+la), so the q-axis panel share rides
+    under the big larfb.  Column independence of the reflector apply (per
+    output element, the reduction runs over rows only) makes the
+    early/late split bit-identical to the single masked apply at la=0."""
     r = lax.axis_index(AXIS_P)
     c = lax.axis_index(AXIS_Q)
     nb = a_loc.shape[-1]
@@ -139,29 +147,42 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
     Vtree0 = jnp.zeros((Kt, p * nb, nb), dt)
     Ttree0 = jnp.zeros((Kt, nb, nb), dt)
 
-    def step(k, carry):
-        a_loc, Tloc, Vtree, Ttree = carry
-        rk, ck = k % p, k % q
+    def _share_psum(x, ck):
+        return bcast_along(x, ck, AXIS_Q)
+
+    def _share_ring(x, ck):
+        return ring_bcast_from_col(x, ck, q)
+
+    def panel_qr(a_loc, k, share):
+        """Local panel QR of tile-column k + owner-column share along q.
+        ``share`` is _share_psum (depth 0) or _share_ring (lookahead
+        issue) — both deliver the owner's exact bytes."""
+        kkc = k // q
+        ck = k % q
+        skip = skips[k, r]
+        pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
+        pan = jnp.where((gi_all >= k)[:, None, None], pan,
+                        jnp.zeros_like(pan))
+        pan = jnp.roll(pan, -skip, axis=0)
+        slab = pan.reshape(mtl * nb, nb)
+        packed, Tr = geqrf_panel(slab)   # tuned: Pallas panel or XLA
+        # only the owner column's panel is real; share it across the row
+        packed = jnp.where(c == ck, packed, jnp.zeros_like(packed))
+        Tr = jnp.where(c == ck, Tr, jnp.zeros_like(Tr))
+        packed = share(packed, ck)
+        Tr = share(Tr, ck)
+        return packed, Tr
+
+    def consume(k, a_loc, Tloc, Vtree, Ttree, packed, Tr):
+        """Tree factor + V writeback for step k from the shared panel;
+        returns the pieces the trailing updates need."""
+        rk = k % p
+        ck = k % q
         kkc = k // q
         skip = skips[k, r]
         posr = poss[k, r]
-
-        # ---- local panel QR on my rolled rows of tile-column k ----
-        with span("slate.geqrf/panel"):
-            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1, keepdims=False)
-            pan0 = pan
-            pan = jnp.where((gi_all >= k)[:, None, None], pan,
-                            jnp.zeros_like(pan))
-            pan = jnp.roll(pan, -skip, axis=0)
-            slab = pan.reshape(mtl * nb, nb)
-            packed, Tr = geqrf_panel(slab)   # tuned: Pallas panel or XLA
-            # only the owner column's panel is real; share it across the row
-            packed = bcast_along(jnp.where(c == ck, packed,
-                                           jnp.zeros_like(packed)), ck, AXIS_Q)
-            Tr = bcast_along(jnp.where(c == ck, Tr, jnp.zeros_like(Tr)),
-                             ck, AXIS_Q)
-            Vr = unit_lower(packed)
-            Tloc = Tloc.at[k].set(Tr)
+        Vr = unit_lower(packed)
+        Tloc = Tloc.at[k].set(Tr)
 
         # ---- R-stack tree: gather nb x nb R factors, factor replicated ----
         with span("slate.geqrf/tree"):
@@ -178,6 +199,8 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
 
         # ---- write back V (head tile: strict lower; diag tile adds R) ----
         with span("slate.geqrf/writeback"):
+            pan0 = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                            keepdims=False)
             head = jnp.tril(packed[:nb], -1)
             head = jnp.where(r == rk, head + Rfin, head)
             vstore = packed.at[:nb].set(head)
@@ -187,29 +210,74 @@ def _geqrf_local(a_loc, Kt, Mt, m, n, p, q, mtl, ntl):
             zi = jnp.zeros((), jnp.int32)
             a_loc = lax.dynamic_update_slice(
                 a_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+        return a_loc, Tloc, Vtree, Ttree, Vr, Vs_mine, Ts
 
-        # ---- trailing update: Q^H on columns gj > k (one psum for tree) ----
+    def apply_cols(k, a_loc, colsel, Vr, Tr, Vs_mine, Ts):
+        """Q^H on the local rows of the columns selected by ``colsel``
+        (boolean over gj_all).  Zeroed non-selected columns pass through
+        the reflectors as exact zeros, so any column split applies each
+        selected column's transform once, bit-identically."""
+        skip = skips[k, r]
         with span("slate.geqrf/update"):
             Cl = _rows_view(a_loc, skip)             # [mtl*nb, ntl*nb]
-            colmask = jnp.repeat(gj_all > k, nb)[None, :]
+            colmask = jnp.repeat(colsel, nb)[None, :]
             Cm = jnp.where(colmask, Cl, jnp.zeros_like(Cl))
             Cm = _panel_apply(Cm, Vr, Tr, Vs_mine, Ts, conj_trans=True)
             Cl = jnp.where(colmask, Cm, Cl)
             newt = _rows_unview(Cl, skip, mtl, ntl, nb)
             rowmask = (gi_all >= k)[:, None, None, None]
-            cmask = (gj_all > k)[None, :, None, None]
-            a_loc = jnp.where(rowmask & cmask, newt, a_loc)
-        return a_loc, Tloc, Vtree, Ttree
+            cmask = colsel[None, :, None, None]
+            return jnp.where(rowmask & cmask, newt, a_loc)
 
-    return lax.fori_loop(0, Kt, step, (a_loc, Tloc0, Vtree0, Ttree0))
+    if la == 0:
+        def step(k, carry):
+            a_loc, Tloc, Vtree, Ttree = carry
+            # ---- local panel QR on my rolled rows of tile-column k ----
+            with span("slate.geqrf/panel"):
+                packed, Tr = panel_qr(a_loc, k, _share_psum)
+            a_loc, Tloc, Vtree, Ttree, Vr, Vs_mine, Ts = consume(
+                k, a_loc, Tloc, Vtree, Ttree, packed, Tr)
+            # ---- trailing update: Q^H on columns gj > k ----
+            a_loc = apply_cols(k, a_loc, gj_all > k, Vr, Tr, Vs_mine, Ts)
+            return a_loc, Tloc, Vtree, Ttree
+
+        return lax.fori_loop(0, Kt, step, (a_loc, Tloc0, Vtree0, Ttree0))
+
+    def step(k, carry):
+        a_loc, Tloc, Vtree, Ttree, packed, Tr = carry
+        a_loc, Tloc, Vtree, Ttree, Vr, Vs_mine, Ts = consume(
+            k, a_loc, Tloc, Vtree, Ttree, packed, Tr)
+        # ---- lookahead: finish columns k+1..k+la, issue step k+1's
+        #      panel (ring), THEN the late trailing update rides over
+        #      the in-flight hops.  The final step re-issues the clamped
+        #      last column; the garbage panel dies with the carry ----
+        a_loc = apply_cols(k, a_loc, (gj_all > k) & (gj_all <= k + la),
+                           Vr, Tr, Vs_mine, Ts)
+        with span("slate.geqrf/bcast_ahead"):
+            nxt = panel_qr(a_loc, jnp.minimum(k + 1, Kt - 1),
+                           _share_ring)
+        a_loc = apply_cols(k, a_loc, gj_all > k + la, Vr, Tr, Vs_mine, Ts)
+        return (a_loc, Tloc, Vtree, Ttree) + nxt
+
+    with span("slate.geqrf/bcast_ahead"):
+        packed0, Tr0 = panel_qr(a_loc, 0, _share_ring)
+    a_loc, Tloc, Vtree, Ttree, _, _ = lax.fori_loop(
+        0, Kt, step, (a_loc, Tloc0, Vtree0, Ttree0, packed0, Tr0))
+    return a_loc, Tloc, Vtree, Ttree
 
 
-def dist_geqrf_data(data, Kt, Mt, m, n, grid: Grid):
+def dist_geqrf_data(data, Kt, Mt, m, n, grid: Grid, la: int | None = None):
+    """``la`` is the lookahead pipeline depth; None resolves the tuned
+    depth through the ``dist_lookahead`` plan (SEAM011)."""
+    if la is None:
+        from ..tune import lookahead_depth
+        la = lookahead_depth(n, data.dtype.name)
     mtl = data.shape[0] // grid.p
     ntl = data.shape[1] // grid.q
     spec = TILE_SPEC
     fn = shard_map_unchecked(
-        lambda a: _geqrf_local(a, Kt, Mt, m, n, grid.p, grid.q, mtl, ntl),
+        lambda a: _geqrf_local(a, Kt, Mt, m, n, grid.p, grid.q, mtl, ntl,
+                               la=la),
         mesh=grid.mesh, in_specs=(spec,),
         out_specs=(spec, P(AXIS_P, None, None), P(), P()))
     data, Tloc, Vtree, Ttree = fn(data)

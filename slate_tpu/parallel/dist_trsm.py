@@ -34,6 +34,7 @@ from ..core.grid import AXIS_P, AXIS_Q, TILE_SPEC, Grid
 from ..internal.trsm import apply_op_tile
 from ..robust import faults
 from ..types import Op, Uplo
+from ..util.trace import span
 from .dist_chol import superblock
 
 
@@ -53,69 +54,72 @@ def _trsm_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a, unit_diag,
 
     def step(k, b_loc):
         """Solve block row k and broadcast X(k,:) + A's effective panel."""
-        rk, ck = k % p, k % q
-        kkr, kkc = k // p, k // q
+        with span("slate.trsm/bcast"):
+            rk, ck = k % p, k % q
+            kkr, kkc = k // p, k // q
 
-        # -- effective diagonal tile (pad diagonal identity-augmented so
-        # the ragged last tile stays nonsingular; B's pad rows are zero so
-        # the pad solution is exactly zero) --
-        vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
-        pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
-        dtile = lax.dynamic_index_in_dim(
-            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
-            kkc, axis=0, keepdims=False)
-        dtile = jnp.where((r == rk) & (c == ck), dtile,
-                          jnp.zeros((nb, nb), a_loc.dtype))
-        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
-        deff = apply_op_tile(dtile, op_a) + pad_eye
+            # -- effective diagonal tile (pad diagonal identity-augmented
+            # so the ragged last tile stays nonsingular; B's pad rows are
+            # zero so the pad solution is exactly zero) --
+            vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
+            pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
+            dtile = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+                kkc, axis=0, keepdims=False)
+            dtile = jnp.where((r == rk) & (c == ck), dtile,
+                              jnp.zeros((nb, nb), a_loc.dtype))
+            dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+            deff = apply_op_tile(dtile, op_a) + pad_eye
 
-        # -- solve block row k of B on its owner row, bcast along p --
-        brow = lax.dynamic_index_in_dim(b_loc, kkr, axis=0, keepdims=False)
-        xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
-            deff, bb, left_side=True, lower=eff_lower,
-            unit_diagonal=unit_diag))(brow)
-        xk = jnp.where(r == rk, xk, jnp.zeros_like(xk))
-        xk = lax.psum(xk, AXIS_P)                   # replicated down columns
-        xk = faults.maybe_corrupt("post_collective", xk)
-        row_sel = jnp.where(r == rk, xk, brow)
-        b_loc = lax.dynamic_update_slice(
-            b_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
-
-        # -- effective panel column k of A, as a global buffer --
-        # op == NoTrans: tiles A(i, k) live in mesh col ck at local col kkc
-        # op != NoTrans: tiles op(A(k, i)) live in mesh row rk, local row kkr
-        if op_a is Op.NoTrans:
-            pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
-                                           keepdims=False)
-            gi_a = r + p * jnp.arange(mtl_a)
-            buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gi_a].set(pan)
-            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
-        else:
-            arow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+            # -- solve block row k of B on its owner row, bcast along p --
+            brow = lax.dynamic_index_in_dim(b_loc, kkr, axis=0,
                                             keepdims=False)
-            pan = apply_op_tile(arow, op_a)         # [ntl_a, nb, nb]
-            gj_a = c + q * jnp.arange(ntl_a)
-            buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gj_a].set(pan)
-            buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
-        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
-        return b_loc, xk, gpan
+            xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
+                deff, bb, left_side=True, lower=eff_lower,
+                unit_diagonal=unit_diag))(brow)
+            xk = jnp.where(r == rk, xk, jnp.zeros_like(xk))
+            xk = lax.psum(xk, AXIS_P)               # replicated down columns
+            xk = faults.maybe_corrupt("post_collective", xk)
+            row_sel = jnp.where(r == rk, xk, brow)
+            b_loc = lax.dynamic_update_slice(
+                b_loc, row_sel[None], (kkr.astype(jnp.int32), zi, zi, zi))
+
+            # -- effective panel column k of A, as a global buffer --
+            # op == NoTrans: tiles A(i, k) live in mesh col ck, local col kkc
+            # op != NoTrans: tiles op(A(k, i)) in mesh row rk, local row kkr
+            if op_a is Op.NoTrans:
+                pan = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                               keepdims=False)
+                gi_a = r + p * jnp.arange(mtl_a)
+                buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
+                buf = buf.at[gi_a].set(pan)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+            else:
+                arow = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                                keepdims=False)
+                pan = apply_op_tile(arow, op_a)     # [ntl_a, nb, nb]
+                gj_a = c + q * jnp.arange(ntl_a)
+                buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
+                buf = buf.at[gj_a].set(pan)
+                buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
+            gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+            return b_loc, xk, gpan
 
     def update(b_loc, k, xk, gpan, S, sr):
         """B(i,:) -= Aeff(i,k) @ X(k,:) on the not-yet-solved window."""
-        gi = r + p * (sr + jnp.arange(S))
-        arow = gpan[gi]                             # [S, nb, nb]
-        cur = lax.dynamic_slice(b_loc, (sr, zi, zi, zi),
-                                (S, ntl_b, nb, nbr))
-        upd = jnp.einsum("iab,jbc->ijac", arow, xk,
-                         preferred_element_type=dt)
-        if eff_lower:
-            mask = (gi > k)[:, None, None, None]
-        else:
-            mask = (gi < k)[:, None, None, None]
-        new = jnp.where(mask, cur - upd, cur)
-        return lax.dynamic_update_slice(b_loc, new, (sr, zi, zi, zi))
+        with span("slate.trsm/update"):
+            gi = r + p * (sr + jnp.arange(S))
+            arow = gpan[gi]                         # [S, nb, nb]
+            cur = lax.dynamic_slice(b_loc, (sr, zi, zi, zi),
+                                    (S, ntl_b, nb, nbr))
+            upd = jnp.einsum("iab,jbc->ijac", arow, xk,
+                             preferred_element_type=dt)
+            if eff_lower:
+                mask = (gi > k)[:, None, None, None]
+            else:
+                mask = (gi < k)[:, None, None, None]
+            new = jnp.where(mask, cur - upd, cur)
+            return lax.dynamic_update_slice(b_loc, new, (sr, zi, zi, zi))
 
     if eff_lower:
         for k0 in range(0, Nt, sb):
@@ -176,65 +180,69 @@ def _trsm_right_local(a_loc, b_loc, alpha, *, Nt, n, p, q, lower, op_a,
     # k downward; upper walks upward
 
     def step(k, b_loc):
-        rk, ck = k % p, k % q
-        kkr, kkc = k // p, k // q
+        with span("slate.trsm/bcast"):
+            rk, ck = k % p, k % q
+            kkr, kkc = k // p, k // q
 
-        vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
-        pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
-        dtile = lax.dynamic_index_in_dim(
-            lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
-            kkc, axis=0, keepdims=False)
-        dtile = jnp.where((r == rk) & (c == ck), dtile,
-                          jnp.zeros((nb, nb), a_loc.dtype))
-        dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
-        deff = apply_op_tile(dtile, op_a) + pad_eye
+            vk = jnp.where(k < Nt - 1, nb, n - (Nt - 1) * nb)
+            pad_eye = jnp.diag((idx >= vk).astype(a_loc.dtype))
+            dtile = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(a_loc, kkr, axis=0, keepdims=False),
+                kkc, axis=0, keepdims=False)
+            dtile = jnp.where((r == rk) & (c == ck), dtile,
+                              jnp.zeros((nb, nb), a_loc.dtype))
+            dtile = lax.psum(lax.psum(dtile, AXIS_P), AXIS_Q)
+            deff = apply_op_tile(dtile, op_a) + pad_eye
 
-        # -- solve block column k of B on its owner column, bcast along q --
-        bcol = lax.dynamic_index_in_dim(b_loc, kkc, axis=1, keepdims=False)
-        xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
-            deff, bb, left_side=False, lower=eff_lower,
-            unit_diagonal=unit_diag))(bcol)
-        xk = jnp.where(c == ck, xk, jnp.zeros_like(xk))
-        xk = lax.psum(xk, AXIS_Q)                   # replicated across rows
-        xk = faults.maybe_corrupt("post_collective", xk)
-        col_sel = jnp.where(c == ck, xk, bcol)
-        b_loc = lax.dynamic_update_slice(
-            b_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
-
-        # -- effective row k of A as a global buffer over tile columns --
-        # op == NoTrans: tiles A(k, j) live in mesh row rk at local row kkr
-        # op != NoTrans: tiles op(A(j, k)) live in mesh col ck, local col kkc
-        if op_a is Op.NoTrans:
-            pan = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
-                                           keepdims=False)
-            gj_a = c + q * jnp.arange(ntl_a)
-            buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gj_a].set(pan)
-            buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
-        else:
-            acol = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+            # -- solve block column k of B on its owner column, bcast
+            # along q --
+            bcol = lax.dynamic_index_in_dim(b_loc, kkc, axis=1,
                                             keepdims=False)
-            pan = apply_op_tile(acol, op_a)         # [mtl_a, nb, nb]
-            gi_a = r + p * jnp.arange(mtl_a)
-            buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
-            buf = buf.at[gi_a].set(pan)
-            buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
-        gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
-        return b_loc, xk, gpan
+            xk = jax.vmap(lambda bb: lax.linalg.triangular_solve(
+                deff, bb, left_side=False, lower=eff_lower,
+                unit_diagonal=unit_diag))(bcol)
+            xk = jnp.where(c == ck, xk, jnp.zeros_like(xk))
+            xk = lax.psum(xk, AXIS_Q)               # replicated across rows
+            xk = faults.maybe_corrupt("post_collective", xk)
+            col_sel = jnp.where(c == ck, xk, bcol)
+            b_loc = lax.dynamic_update_slice(
+                b_loc, col_sel[:, None], (zi, kkc.astype(jnp.int32), zi, zi))
+
+            # -- effective row k of A as a global buffer over tile columns --
+            # op == NoTrans: tiles A(k, j) live in mesh row rk, local row kkr
+            # op != NoTrans: tiles op(A(j, k)) in mesh col ck, local col kkc
+            if op_a is Op.NoTrans:
+                pan = lax.dynamic_index_in_dim(a_loc, kkr, axis=0,
+                                               keepdims=False)
+                gj_a = c + q * jnp.arange(ntl_a)
+                buf = jnp.zeros((q * ntl_a, nb, nb), a_loc.dtype)
+                buf = buf.at[gj_a].set(pan)
+                buf = jnp.where(r == rk, buf, jnp.zeros_like(buf))
+            else:
+                acol = lax.dynamic_index_in_dim(a_loc, kkc, axis=1,
+                                                keepdims=False)
+                pan = apply_op_tile(acol, op_a)     # [mtl_a, nb, nb]
+                gi_a = r + p * jnp.arange(mtl_a)
+                buf = jnp.zeros((p * mtl_a, nb, nb), a_loc.dtype)
+                buf = buf.at[gi_a].set(pan)
+                buf = jnp.where(c == ck, buf, jnp.zeros_like(buf))
+            gpan = lax.psum(lax.psum(buf, AXIS_P), AXIS_Q)
+            return b_loc, xk, gpan
 
     def update(b_loc, k, xk, gpan, T, sc):
-        gj = c + q * (sc + jnp.arange(T))
-        acol = gpan[gj]                             # [T, nb, nb] Aeff(k, j)
-        cur = lax.dynamic_slice(b_loc, (zi, sc, zi, zi),
-                                (mtl_b, T, mbr, nb))
-        upd = jnp.einsum("iab,jbc->ijac", xk, acol,
-                         preferred_element_type=dt)
-        if eff_lower:
-            mask = (gj < k)[None, :, None, None]
-        else:
-            mask = (gj > k)[None, :, None, None]
-        new = jnp.where(mask, cur - upd, cur)
-        return lax.dynamic_update_slice(b_loc, new, (zi, sc, zi, zi))
+        with span("slate.trsm/update"):
+            gj = c + q * (sc + jnp.arange(T))
+            acol = gpan[gj]                         # [T, nb, nb] Aeff(k, j)
+            cur = lax.dynamic_slice(b_loc, (zi, sc, zi, zi),
+                                    (mtl_b, T, mbr, nb))
+            upd = jnp.einsum("iab,jbc->ijac", xk, acol,
+                             preferred_element_type=dt)
+            if eff_lower:
+                mask = (gj < k)[None, :, None, None]
+            else:
+                mask = (gj > k)[None, :, None, None]
+            new = jnp.where(mask, cur - upd, cur)
+            return lax.dynamic_update_slice(b_loc, new, (zi, sc, zi, zi))
 
     if eff_lower:
         # columns solved from high k downward; updates hit columns < k

@@ -43,12 +43,21 @@ from typing import NamedTuple
 SCHEMA_VERSION = 1
 OPS = ("potrf_tile", "potrf_panel", "getrf_panel", "lu_select",
        "geqrf_panel", "batch_potrf", "batch_getrf", "batch_geqrf")
+# ``dist_lookahead`` is a pseudo-op: it does not pick a panel kernel but
+# the comm/compute pipeline depth of the distributed kernels — kernel
+# "xla" means the bulk-synchronous masked-psum path (depth 0, the parity
+# oracle), kernel "ring" means the lookahead pipeline with ``bw`` as the
+# measured depth (1 or 2).  Resolved only via lookahead_depth(), and —
+# like ``serve_bucket`` — schema-accepted but excluded from OPS so the
+# kernel autotuner's candidate sweeps never try to measure it (lookahead
+# wins are measured end to end by bench_*_lookahead instead).
+DIST_LOOKAHEAD_OP = "dist_lookahead"
 # The serving layer's bucket ladder rides the same cache file but is NOT a
 # kernel-tuning op (no candidate sweep): each recorded entry's ``n`` is one
 # ladder rung for this chip (see serve_buckets / docs/SERVING.md).
 SERVE_BUCKET_OP = "serve_bucket"
-ALL_OPS = OPS + (SERVE_BUCKET_OP,)
-KERNELS = ("xla", "pallas")
+ALL_OPS = OPS + (DIST_LOOKAHEAD_OP, SERVE_BUCKET_OP)
+KERNELS = ("xla", "pallas", "ring")
 
 
 class TilePlan(NamedTuple):
@@ -277,8 +286,9 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
     noted into the open obs event frame (cache hit vs nearest-n
     distance), so production events audit plan usage."""
     from ..obs import events as _obs
-    if op not in OPS:
-        raise ValueError(f"unknown op {op!r} (known: {OPS})")
+    if op not in OPS and op != DIST_LOOKAHEAD_OP:
+        raise ValueError(
+            f"unknown op {op!r} (known: {OPS + (DIST_LOOKAHEAD_OP,)})")
     _warn_removed_env()
     ov = _OVERRIDES.get(op)
     if ov is not None:
@@ -293,6 +303,21 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
         source = "exact" if dist == 0.0 else "nearest"
     _obs.note_plan(op, int(n), dtype, plan.kernel, plan.nb, source, dist)
     return plan
+
+
+def lookahead_depth(n: int, dtype: str = "float32") -> int:
+    """Tuned comm/compute lookahead depth for the distributed kernels.
+
+    The SINGLE accessor the dist wrappers consult (SEAM011 — same
+    contract as resolve_plan, which it rides): host-static arguments,
+    static int result.  Untuned chips resolve to the default XLA_PLAN
+    (kernel "xla") and get depth 0, the bulk-synchronous bit-exact
+    fallback; a tuned ``dist_lookahead`` entry with kernel "ring" turns
+    on the pipeline at depth ``bw``, clamped to the supported 1..2."""
+    plan = resolve_plan(DIST_LOOKAHEAD_OP, n, dtype)
+    if plan.kernel != "ring":
+        return 0
+    return max(1, min(2, int(plan.bw)))
 
 
 def serve_buckets(dtype: str = "float32") -> tuple[int, ...] | None:

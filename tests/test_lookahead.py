@@ -1,0 +1,376 @@
+"""Lookahead-pipelined distributed kernels (PR: dist_lookahead).
+
+Coverage map:
+
+- parity oracle: depth-1/2 double-buffered ring pipelines produce
+  BIT-IDENTICAL storage, health scalars, and ABFT counters vs the
+  depth-0 bulk-synchronous path, for all four kernels (summa, dist_chol,
+  dist_lu, dist_qr), on ragged tilings, non-square grids, both dtypes,
+  ABFT on and off;
+- jaxpr shape: the lookahead path lowers to ppermute rings (absent at
+  depth 0), and the per-step collective count is CONSTANT in the depth —
+  only the summa prologue grows by one ring per extra depth;
+- fault injection: a strike in the in-flight panel buffer
+  (post_collective at depth >= 1) is detected, repaired, and counted
+  identically to the depth-0 oracle;
+- obs: ``slate.<op>/bcast_ahead`` prefetch spans surface as SIBLINGS of
+  the accumulate/update phases in the Chrome export, and the metrics CLI
+  aggregates them with no code change;
+- seam: the ``dist_lookahead`` tune plan (SEAM011) is the only dispatch
+  path — kernel "ring" turns the pipeline on at depth ``bw``.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import obs
+from slate_tpu.core.layout import num_tiles
+from slate_tpu.options import Option
+from slate_tpu.parallel.dist_chol import dist_potrf
+from slate_tpu.parallel.dist_lu import dist_getrf
+from slate_tpu.parallel.dist_qr import dist_geqrf_data
+from slate_tpu.parallel.summa import summa_gemm_data
+from slate_tpu.robust import faults
+from slate_tpu.tune import TilePlan, plan_override
+
+NB = 4
+
+
+def _grid(p, q):
+    return st.Grid(p, q, devices=jax.devices()[: p * q])
+
+
+def _assert_all_equal(base, out, ctx):
+    for i, (x, y) in enumerate(zip(base, out)):
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (ctx, i)
+
+
+def _summa_args(rng, g, dt, m=18, kk=22, n=14):
+    a = rng.standard_normal((m, kk)).astype(dt)
+    b = rng.standard_normal((kk, n)).astype(dt)
+    A = st.Matrix.from_numpy(a, NB, NB, g)
+    B = st.Matrix.from_numpy(b, NB, NB, g)
+    C = st.Matrix.from_numpy(np.zeros((m, n), dt), NB, NB, g)
+    return A.storage, B.storage, C.storage
+
+
+def _summa_all(stg_a, stg_b, stg_c, g, abft, la):
+    Kt = num_tiles(stg_a.n, NB)
+    out = summa_gemm_data(stg_a.data, stg_b.data, stg_c.data, 1.5, 0.5,
+                          Kt, g, abft=abft, la=la)
+    return out if abft else (out,)
+
+
+# ------------------------------------------------------------- parity
+
+def test_summa_parity_fast(rng):
+    """Ragged SUMMA smoke: depth 1 bit-identical to depth 0.  The full
+    grid/dtype/abft/depth matrix lives in the @slow tests — each extra
+    (grid, dtype, abft, la) combination is a fresh multi-minute
+    8-device compile, too heavy for tier-1."""
+    g = _grid(2, 2)
+    sa, sb_, sc = _summa_args(rng, g, "float32")
+    base = _summa_all(sa, sb_, sc, g, False, 0)
+    _assert_all_equal(base, _summa_all(sa, sb_, sc, g, False, 1),
+                      ("summa", False, 1))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("p,q", [(2, 4), (4, 2)])
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+def test_summa_parity_full(rng, p, q, dt):
+    g = _grid(p, q)
+    sa, sb_, sc = _summa_args(rng, g, dt)
+    for abft in (False, True):
+        base = _summa_all(sa, sb_, sc, g, abft, 0)
+        for la in (1, 2):
+            _assert_all_equal(base, _summa_all(sa, sb_, sc, g, abft, la),
+                              ("summa", p, q, dt, abft, la))
+
+
+def _chol_storage(rng, g, dt, n):
+    b = rng.standard_normal((n, n))
+    a = (b @ b.T + n * np.eye(n)).astype(dt)
+    return st.HermitianMatrix.from_numpy(a, NB, st.Uplo.Lower, g).storage
+
+
+@pytest.mark.slow
+def test_chol_parity_fast(rng):
+    n = 13                                    # ragged: 13 = 3*4 + 1
+    g = _grid(2, 2)
+    stg = _chol_storage(rng, g, "float32", n)
+    base = dist_potrf(stg.data, stg.Nt, g, stg.n, abft=True, la=0)
+    _assert_all_equal(base,
+                      dist_potrf(stg.data, stg.Nt, g, stg.n, abft=True,
+                                 la=1), ("chol", 1))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+@pytest.mark.parametrize("abft", [False, True])
+def test_chol_parity_full(rng, dt, abft):
+    g = _grid(2, 4)
+    stg = _chol_storage(rng, g, dt, 21)
+    base = dist_potrf(stg.data, stg.Nt, g, stg.n, abft=abft, la=0)
+    for la in (1, 2):
+        _assert_all_equal(base,
+                          dist_potrf(stg.data, stg.Nt, g, stg.n,
+                                     abft=abft, la=la), ("chol", dt, la))
+
+
+def _lu_storage(rng, g, dt, n):
+    a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(dt)
+    return st.Matrix.from_numpy(a, NB, NB, g).storage
+
+
+@pytest.mark.slow
+def test_lu_parity_fast(rng):
+    n = 17
+    g = _grid(2, 2)
+    stg = _lu_storage(rng, g, "float32", n)
+    base = dist_getrf(stg.data, stg.Nt, g, stg.n, "partial", abft=True,
+                      la=0)
+    _assert_all_equal(base,
+                      dist_getrf(stg.data, stg.Nt, g, stg.n, "partial",
+                                 abft=True, la=1), ("lu", 1))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+@pytest.mark.parametrize("method", ["partial", "nopiv"])
+def test_lu_parity_full(rng, dt, method):
+    g = _grid(2, 4)
+    stg = _lu_storage(rng, g, dt, 21)
+    for abft in (False, True):
+        base = dist_getrf(stg.data, stg.Nt, g, stg.n, method, abft=abft,
+                          la=0)
+        for la in (1, 2):
+            _assert_all_equal(base,
+                              dist_getrf(stg.data, stg.Nt, g, stg.n,
+                                         method, abft=abft, la=la),
+                              ("lu", dt, method, abft, la))
+
+
+def _qr_all(rng, g, dt, m, n, la):
+    a = rng.standard_normal((m, n)).astype(dt)
+    stg = st.Matrix.from_numpy(a, NB, NB, g).storage
+    return dist_geqrf_data(stg.data, num_tiles(n, NB), num_tiles(m, NB),
+                           m, n, g, la=la)
+
+
+@pytest.mark.slow
+def test_qr_parity_fast(rng):
+    g = _grid(2, 2)
+    base = _qr_all(rng, g, "float32", 18, 14, 0)
+    out = _qr_all(rng, g, "float32", 18, 14, 1)
+    _assert_all_equal(base, out, ("qr", 1))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("p,q", [(2, 2), (2, 4)])
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+def test_qr_parity_full(rng, p, q, dt):
+    g = _grid(p, q)
+    base = _qr_all(rng, g, dt, 22, 17, 0)
+    for la in (1, 2):
+        _assert_all_equal(base, _qr_all(rng, g, dt, 22, 17, la),
+                          ("qr", p, q, dt, la))
+
+
+# ------------------------------------------------------------- jaxpr
+
+def _summa_jaxpr(rng, g, la):
+    sa, sb_, sc = _summa_args(rng, g, "float32")
+    Kt = num_tiles(sa.n, NB)
+    return str(jax.make_jaxpr(
+        lambda a, b, c: summa_gemm_data(a, b, c, 1.0, 0.0, Kt, g, la=la))(
+            sa.data, sb_.data, sc.data))
+
+
+def test_jaxpr_summa_ring_present_and_prologue_only_growth(rng):
+    """Depth 0 lowers with NO ppermute; depth >= 1 rings the panels; the
+    extra depth adds exactly one prologue ring pair ((p-1)+(q-1) hops) —
+    the per-step collective count is constant in the depth."""
+    p, q = 2, 4
+    g = _grid(p, q)
+    j0 = _summa_jaxpr(rng, g, 0)
+    j1 = _summa_jaxpr(rng, g, 1)
+    j2 = _summa_jaxpr(rng, g, 2)
+    assert j0.count("ppermute") == 0
+    assert j1.count("ppermute") > 0
+    assert j2.count("ppermute") - j1.count("ppermute") == (p - 1) + (q - 1)
+
+
+@pytest.mark.slow
+def test_jaxpr_factorizations_collective_count_constant_in_depth(rng):
+    """chol/lu/qr carry ONE panel in flight regardless of depth (the
+    extra depth widens the early-update window, pure local compute), so
+    their ppermute and psum counts are identical at depth 1 and 2."""
+    g = _grid(2, 2)
+    n = 13
+    chol = _chol_storage(rng, g, "float32", n)
+    lu = _lu_storage(rng, g, "float32", n)
+
+    def jx(fn):
+        return {la: str(jax.make_jaxpr(lambda d, la=la: fn(d, la))(
+            chol.data if fn is _chol else lu.data if fn is _lu
+            else qr_data)) for la in (0, 1, 2)}
+
+    def _chol(d, la):
+        return dist_potrf(d, chol.Nt, g, chol.n, abft=False, la=la)
+
+    def _lu(d, la):
+        return dist_getrf(d, lu.Nt, g, lu.n, "partial", la=la)
+
+    a = np.random.default_rng(7).standard_normal((18, 14)).astype("f4")
+    qr_stg = st.Matrix.from_numpy(a, NB, NB, g).storage
+    qr_data = qr_stg.data
+
+    def _qr(d, la):
+        return dist_geqrf_data(d, num_tiles(14, NB), num_tiles(18, NB),
+                               18, 14, g, la=la)
+
+    for fn in (_chol, _lu, _qr):
+        js = jx(fn)
+        assert js[0].count("ppermute") == 0, fn.__name__
+        assert js[1].count("ppermute") > 0, fn.__name__
+        assert js[1].count("ppermute") == js[2].count("ppermute"), \
+            fn.__name__
+        assert js[1].count("psum") == js[2].count("psum"), fn.__name__
+
+
+# ----------------------------------------------- in-flight buffer faults
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+def test_summa_inflight_strike_repaired_and_depth_invariant(rng, dt):
+    """A post_collective strike with the pipeline on (the accumulator fed
+    from the in-flight ring buffers) is detected, repaired, and counted
+    identically at every depth, and the repaired product matches the
+    clean run."""
+    g = _grid(2, 2)
+    sa, sb_, sc = _summa_args(rng, g, dt)
+    clean = _summa_all(sa, sb_, sc, g, True, 0)
+    plan = faults.FaultPlan("post_collective", kind="bitflip", seed=3,
+                            tile=(1, 0))
+    outs = {}
+    with faults.inject(plan):
+        for la in (0, 1, 2):
+            outs[la] = _summa_all(sa, sb_, sc, g, True, la)
+    for la in (0, 1, 2):
+        data, det, cor, site = outs[la]
+        assert int(det) == 1 and int(cor) == 1, (dt, la)
+        assert int(site) >= 0, (dt, la)
+    for la in (1, 2):
+        _assert_all_equal(outs[0], outs[la], ("summa-strike", dt, la))
+    np.testing.assert_allclose(np.asarray(outs[0][0]),
+                               np.asarray(clean[0]), atol=1e-6)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dt", ["float32", "float64"])
+def test_chol_inflight_panel_strike_depth_invariant(rng, dt):
+    """dist_chol's post_collective site IS the in-flight gathered panel
+    buffer at depth >= 1: strike it, and detection/repair counters and
+    the factored bytes must match the depth-0 oracle exactly."""
+    g = _grid(2, 2)
+    stg = _chol_storage(rng, g, dt, 13)
+    plan = faults.FaultPlan("post_collective", kind="bitflip", seed=3,
+                            tile=(1, 0))
+    outs = {}
+    with faults.inject(plan):
+        for la in (0, 1, 2):
+            outs[la] = dist_potrf(stg.data, stg.Nt, g, stg.n, abft=True,
+                                  la=la)
+    det0, cor0 = int(outs[0][3]), int(outs[0][4])
+    assert det0 >= 1 and cor0 == det0, dt
+    for la in (1, 2):
+        _assert_all_equal(outs[0], outs[la], ("chol-strike", dt, la))
+
+
+# ------------------------------------------------------------- obs
+
+def _run_gemm_lookahead(rng, g):
+    a = rng.standard_normal((18, 22))
+    b = rng.standard_normal((22, 14))
+    A = st.Matrix.from_numpy(a, NB, NB, g)
+    B = st.Matrix.from_numpy(b, NB, NB, g)
+    with plan_override("dist_lookahead", TilePlan("ring", NB, 1)):
+        C = st.gemm(1.0, A, B)
+    return a @ b, C
+
+
+def test_prefetch_spans_are_siblings_in_chrome_export(rng, tmp_path):
+    """slate.gemm/bcast_ahead rides NEXT to slate.gemm/accumulate in the
+    exported flame graph: same tid, same parent boundary span, child
+    depth — the timeline shows prefetch beside compute, not nested in
+    it (extends test_chrome_export_preserves_span_nesting)."""
+    g = _grid(2, 2)
+    with obs.record_spans() as rec:
+        ref, C = _run_gemm_lookahead(rng, g)
+    np.testing.assert_allclose(C.to_numpy(), ref, atol=1e-10)
+    path = tmp_path / "trace.json"
+    rec.export_chrome_trace(str(path))
+    with open(path, encoding="utf-8") as fh:
+        events = json.load(fh)["traceEvents"]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    parents = by_name.get("slate.gemm")
+    assert parents, sorted(by_name)
+    parent = parents[0]
+    ahead = by_name.get("slate.gemm/bcast_ahead")
+    acc = by_name.get("slate.gemm/accumulate")
+    assert ahead and acc, sorted(by_name)
+    eps = 0.5
+    p0, p1 = parent["ts"], parent["ts"] + parent["dur"]
+    for ch in ahead + acc:
+        assert ch["tid"] == parent["tid"]
+        assert ch["args"]["depth"] >= parent["args"]["depth"] + 1
+        assert ch["ts"] >= p0 - eps
+        assert ch["ts"] + ch["dur"] <= p1 + eps
+    # siblings: prefetch spans sit at the SAME depth as the accumulate
+    # phase they overlap with, never inside it
+    assert {e["args"]["depth"] for e in ahead} == \
+        {e["args"]["depth"] for e in acc}
+
+
+def test_metrics_cli_aggregates_prefetch_spans(rng, tmp_path):
+    """The metrics aggregator counts bcast_ahead spans from span JSONL
+    with no code change, alongside the driver events of the same run."""
+    g = _grid(2, 2)
+    evp = tmp_path / "ev.jsonl"
+    spp = tmp_path / "spans.jsonl"
+    obs.enable(str(evp))
+    try:
+        with obs.record_spans() as rec:
+            _run_gemm_lookahead(rng, g)
+    finally:
+        obs.disable()
+    names = [s["name"] for s in rec.spans]
+    assert "slate.gemm/bcast_ahead" in names
+    rec.export_jsonl(str(spp))
+    s = obs.summarize([str(evp), str(spp)])
+    assert s["counts"]["spans"] == len(rec.spans) > 0
+    assert s["counts"]["events"] >= 1
+    assert s["counts"]["malformed"] == 0
+    assert "gemm" in s["ops"]
+    text = obs.render(s)
+    assert "spans" in text
+
+
+# ------------------------------------------------------------- seam
+
+def test_lookahead_depth_resolves_through_plan(rng):
+    from slate_tpu.tune import lookahead_depth
+    assert lookahead_depth(4096) == 0          # untuned -> oracle
+    with plan_override("dist_lookahead", TilePlan("ring", 256, 2)):
+        assert lookahead_depth(4096) == 2
+    with plan_override("dist_lookahead", TilePlan("ring", 256, 7)):
+        assert lookahead_depth(4096) == 2      # clamped to supported 1..2
+    with plan_override("dist_lookahead", TilePlan("xla", 256, 1)):
+        assert lookahead_depth(4096) == 0

@@ -205,6 +205,13 @@ def test_compare_direction_and_noise_model():
     assert obs_compare.noise_pct("sweep_potrf_xla") == 10.0
     assert obs_compare.noise_pct("gemm_n4096_gflops_per_chip") == \
         obs_compare.DEFAULT_NOISE_PCT
+    # PERF r15 pipeline metrics ride the wider multi-device noise band,
+    # and the speedup/overlap ratios count as higher-is-better
+    assert obs_compare.noise_pct("summa_lookahead_d1_n8192_gflops") == 10.0
+    assert obs_compare.noise_pct("dist_chol_lookahead_speedup_n16384") == \
+        10.0
+    assert obs_compare.direction("summa_lookahead_overlap_pct_n8192") == \
+        "higher"
 
 
 def _round(tmp_path, name, values):

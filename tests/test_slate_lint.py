@@ -1430,6 +1430,101 @@ def test_col008_silent_on_consistent_ring(tmp_path):
     assert lint(root, {"COL008"}) == []
 
 
+def test_col008_silent_on_double_buffered_loop_body(tmp_path):
+    # PERF r15 lookahead idiom: the pipeline's loop body ring-shifts BOTH
+    # the live panel and the in-flight prefetch buffer along the same +1
+    # ring — two same-direction hops per axis are one consistent ring
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def body(k, carry):
+            cur, nxt = carry
+            cur = lax.ppermute(cur, AXIS_P,
+                               [(i, (i + 1) % 4) for i in range(4)])
+            nxt = lax.ppermute(nxt, AXIS_P,
+                               [(i, (i + 1) % 4) for i in range(4)])
+            return (nxt, cur)
+
+
+        @jax.jit
+        def entry(x):
+            return lax.fori_loop(0, 8, body, (x, x))
+        """)})
+    assert lint(root, {"COL008"}) == []
+
+
+def test_col008_fires_on_double_buffer_direction_mismatch(tmp_path):
+    # ...but a prefetch buffer shifted AGAINST the live panel's ring
+    # means the two buffers' send/recv partners never pair up
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def body(k, carry):
+            cur, nxt = carry
+            cur = lax.ppermute(cur, AXIS_P,
+                               [(i, (i + 1) % 4) for i in range(4)])
+            nxt = lax.ppermute(nxt, AXIS_P,
+                               [(i, (i - 1) % 4) for i in range(4)])
+            return (nxt, cur)
+
+
+        @jax.jit
+        def entry(x):
+            return lax.fori_loop(0, 8, body, (x, x))
+        """)})
+    fs = lint(root, {"COL008"})
+    assert [f.rule for f in fs] == ["COL008"]
+
+
+def test_col006_pipeline_epilogue_must_keep_ring_sequence(tmp_path):
+    # lookahead pipeline shape: prologue ring hop, then a steady-state
+    # cond whose taken arm rings the NEXT panel and psums the update.
+    # An epilogue arm that drops the ring (instead of only local work)
+    # diverges the branch collective sequences and fires.
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _steady(x):
+            nxt = lax.ppermute(x, AXIS_P,
+                               [(i, (i + 1) % 4) for i in range(4)])
+            return nxt + lax.psum(x, AXIS_P)
+
+
+        def _epilogue(x):
+            return x + lax.psum(x, AXIS_P)
+
+
+        @jax.jit
+        def entry(x):
+            x = lax.ppermute(x, AXIS_P,
+                             [(i, (i + 1) % 4) for i in range(4)])
+            return lax.cond(x.ndim > 1, _steady, _epilogue, x)
+        """)})
+    fs = lint(root, {"COL006"})
+    assert [f.rule for f in fs] == ["COL006"]
+    assert "ppermute@p" in fs[0].message
+
+
+def test_col006_silent_on_uniform_pipeline_sequences(tmp_path):
+    # the CORRECT epilogue keeps the ring (a dead hop on zeroed data,
+    # exactly how the pipelined kernels retire their final clamped
+    # issue) so prologue/steady-state/epilogue all run one sequence
+    root = mini_repo(tmp_path, {**COL_GRID, "slate_tpu/mod.py": _col_mod("""\
+        def _steady(x):
+            nxt = lax.ppermute(x, AXIS_P,
+                               [(i, (i + 1) % 4) for i in range(4)])
+            return nxt + lax.psum(x, AXIS_P)
+
+
+        def _epilogue(x):
+            dead = lax.ppermute(x * 0.0, AXIS_P,
+                                [(i, (i + 1) % 4) for i in range(4)])
+            return dead + lax.psum(x, AXIS_P)
+
+
+        @jax.jit
+        def entry(x):
+            x = lax.ppermute(x, AXIS_P,
+                             [(i, (i + 1) % 4) for i in range(4)])
+            return lax.cond(x.ndim > 1, _steady, _epilogue, x)
+        """)})
+    assert lint(root, {"COL006"}) == []
+
+
 # --------------------------------------------------------------------------
 # lock-discipline pack (CON001-CON003)
 
