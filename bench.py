@@ -703,6 +703,74 @@ def bench_serve_ragged(problems, nrhs, reps, bucket):
                       "unit": "x", "n": problems}), flush=True)
 
 
+def bench_serve_survival(problems, rate_hz, nrhs, sizes, budget_ms):
+    """Survival-layer throughput (robustness PR): a seeded Poisson
+    arrival stream (robust.faults.poisson_workload) replayed against a
+    LIVE Server — background flush loop, deadline-aware admission,
+    shed_oldest overflow, SLO governor — instead of the offline
+    serve_batch path the other serve benches time.  Reports admitted
+    problems/s over the replay wall time, delivered p99 latency, the
+    shed and quarantine rates per 1k, and an ``slo_pass`` verdict from
+    slo.evaluate over the recorded event stream (p99 must hold the
+    declared budget for what the server chose to ADMIT — shedding is
+    how it keeps that promise under overload).  Emits its own lines:
+    these metrics are problems/s, ms and per-1k rates, not GFLOP/s."""
+    from slate_tpu import obs, serve
+    from slate_tpu.obs import slo as _slo
+    from slate_tpu.robust import faults as _faults
+
+    work = _faults.poisson_workload(16, problems, rate_hz, sizes,
+                                    nrhs=nrhs)
+    cfg = serve.AdmissionConfig(
+        max_queue=max(problems // 4, 8), overflow="shed_oldest",
+        flush_occupancy=max(problems // 8, 4), max_batch_delay_ms=10.0,
+        slo_budget_ms=float(budget_ms), watchdog_timeout_s=120.0)
+    srv = serve.Server(cache=serve.ExecutableCache(), admission=cfg)
+    _PROGRESS["phase"] = "compile"
+    srv.serve_batch([(op, a, b) for _, op, a, b in work])  # warm buckets
+    _PROGRESS["phase"] = "run"
+    srv.start()
+    tickets, shed = [], 0
+    t0 = time.perf_counter()
+    with obs.recording() as events:
+        for t_arr, op, a, b in work:
+            lag = t_arr - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            try:
+                tickets.append(srv.submit(op, a, b))
+            except Exception:          # typed shed/overflow: counted
+                shed += 1
+        for tk in tickets:
+            try:
+                tk.result(timeout=60.0)
+            except Exception:
+                shed += 1
+        wall = time.perf_counter() - t0
+        srv.shutdown()
+    stats = _slo.aggregate(list(events))
+    union = stats.get("*", {})
+    verdicts = _slo.evaluate(stats, {"*": {"latency_p99_ms": budget_ms}})
+    served = union.get("problems", 0)
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "serve_survival_problems_per_s",
+                      "value": round(served / max(wall, 1e-9), 2),
+                      "unit": "problems/s", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_survival_latency_p99_ms",
+                      "value": union.get("latency_p99_ms"),
+                      "unit": "ms", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_survival_shed_per_1k",
+                      "value": round(1000.0 * shed
+                                     / max(problems, 1), 2),
+                      "unit": "per_1k", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_survival_quar_per_1k",
+                      "value": union.get("quar_per_1k", 0.0),
+                      "unit": "per_1k", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_survival_slo_pass",
+                      "value": int(all(v["ok"] for v in verdicts)),
+                      "unit": "bool", "n": problems}), flush=True)
+
+
 QUICK_STEPS = [
     (bench_gemm, dict(n=512, nb=128, iters=4)),
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
@@ -721,6 +789,8 @@ QUICK_STEPS = [
     (bench_serve_mixed, dict(problems=24, nrhs=4, reps=2,
                              sizes=(24, 48, 96))),
     (bench_serve_ragged, dict(problems=12, nrhs=4, reps=2, bucket=32)),
+    (bench_serve_survival, dict(problems=24, rate_hz=400.0, nrhs=4,
+                                sizes=(24, 48), budget_ms=5000.0)),
 ]
 
 FULL_STEPS = [
@@ -743,6 +813,8 @@ FULL_STEPS = [
     (bench_serve_mixed, dict(problems=96, nrhs=16, reps=3,
                              sizes=(48, 96, 160, 320))),
     (bench_serve_ragged, dict(problems=48, nrhs=16, reps=3, bucket=256)),
+    (bench_serve_survival, dict(problems=192, rate_hz=800.0, nrhs=16,
+                                sizes=(48, 96, 160), budget_ms=2000.0)),
 ]
 
 

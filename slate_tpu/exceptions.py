@@ -44,6 +44,37 @@ class SlateSingularError(SlateError):
         self.info = info
 
 
+class SlateServeError(SlateError):
+    """Serving front-door failure (admission, flush, watchdog).
+
+    The serving layer never loses an error: flush failures are stored
+    per-request on the ticket (sticky) and re-raised at the caller's
+    ``result()`` / ``drain()`` site, so a failed background flush is
+    loud even when the queue is empty by the time anyone looks."""
+
+
+class SlateServeTimeoutError(SlateServeError):
+    """A request or flush ran out of time: the watchdog declared an
+    in-flight flush wedged (stuck compile or device hang), a per-request
+    deadline would expire before service, or ``Ticket.result(timeout)``
+    elapsed.  ``reason`` carries which (``watchdog`` / ``deadline`` /
+    ``result_timeout`` / ``wedged`` / ``shutdown``)."""
+
+    def __init__(self, msg: str, reason: str = "timeout"):
+        super().__init__(msg)
+        self.reason = reason
+
+
+class SlateServeOverloadError(SlateServeError):
+    """Admission control rejected or shed a request under overload
+    (bounded queue full, or SLO backpressure tightened capacity).
+    ``policy`` names the overflow policy that fired."""
+
+    def __init__(self, msg: str, policy: str = "reject"):
+        super().__init__(msg)
+        self.policy = policy
+
+
 def slate_error(cond: bool, msg: str = "error") -> None:
     """Raise SlateValueError unless ``cond`` (ref: Exception.hh slate_error)."""
     if not cond:

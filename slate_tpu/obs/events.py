@@ -331,6 +331,28 @@ def emit_serve_batch(payload: dict) -> None:
            **payload})
 
 
+def emit_serve_shed(payload: dict) -> None:
+    """One record per request shed by serving admission control (kind
+    ``serve_shed``; serve/server.py is the only caller).  The payload
+    carries op/dtype, the shed ``reason`` (deadline / overflow_* /
+    watchdog / shutdown), the victim's age and the queue depth — the
+    inputs behind the serving table's ``shed/1k`` column."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": "serve_shed", "ts": time.time(),
+           **payload})
+
+
+def emit_serve_quarantine(payload: dict) -> None:
+    """One record per request quarantined to the singleton slow path
+    after exhausting the fresh-batch retry (kind ``serve_quarantine``;
+    serve/server.py is the only caller) — the ``quar/1k`` column."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": "serve_quarantine", "ts": time.time(),
+           **payload})
+
+
 def _emit(event: dict) -> None:
     with _LOCK:
         _RING.append(event)

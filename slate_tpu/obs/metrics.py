@@ -105,7 +105,8 @@ def split_records(records):
             events.append(r)
         elif schema == EVENT_SCHEMA and kind == "span":
             spans.append(r)
-        elif schema == EVENT_SCHEMA and kind == "serve_batch":
+        elif schema == EVENT_SCHEMA and kind in (
+                "serve_batch", "serve_shed", "serve_quarantine"):
             serve.append(r)
         elif schema == BENCH_SCHEMA or "metric" in r:
             bench.append(r)
@@ -219,14 +220,27 @@ def summarize_serve(serve) -> dict:
     retrace/compile accounting that proves a warmed server stays warm,
     and ``wa_pps`` — padding-waste-adjusted problems/s, raw throughput
     over the batch durations divided by (1 - waste): throughput per
-    unit of LIVE work, the number the ragged serving cores improve."""
+    unit of LIVE work, the number the ragged serving cores improve.
+
+    Survival records ride the same stream: ``serve_shed`` records count
+    into ``shed`` / ``shed_per_1k`` (per 1k offered = served + shed)
+    and ``serve_quarantine`` into ``quarantined`` / ``quar_per_1k``
+    (per 1k served problems)."""
     table: dict[str, dict] = {}
     for e in serve:
         key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
         s = table.setdefault(key, {
             "batches": 0, "problems": 0, "escalated": 0, "compiles": 0,
-            "retraces": 0, "_occ": [], "_waste": [], "_dur_ms": 0.0,
+            "retraces": 0, "shed": 0, "quarantined": 0,
+            "_occ": [], "_waste": [], "_dur_ms": 0.0,
             "_lat": [], "_age": [], "_mfu": []})
+        kind = e.get("kind")
+        if kind == "serve_shed":
+            s["shed"] += 1
+            continue
+        if kind == "serve_quarantine":
+            s["quarantined"] += 1
+            continue
         s["batches"] += 1
         s["problems"] += int(e.get("problems") or 0)
         s["escalated"] += int(e.get("escalated") or 0)
@@ -260,6 +274,9 @@ def summarize_serve(serve) -> dict:
         s["mfu"] = round(sum(mfus) / len(mfus), 4) if mfus else None
         probs = max(s["problems"], 1)
         s["esc_per_1k"] = round(1000.0 * s["escalated"] / probs, 2)
+        offered = max(s["problems"] + s["shed"], 1)
+        s["shed_per_1k"] = round(1000.0 * s["shed"] / offered, 2)
+        s["quar_per_1k"] = round(1000.0 * s["quarantined"] / probs, 2)
         w = s["padding_waste_p50"] or 0.0
         s["wa_pps"] = (round(s["problems"] / dur_s / max(1.0 - w, 1e-9), 2)
                        if dur_s > 0 else None)
@@ -330,12 +347,13 @@ def render(summary: dict) -> str:
                  s["occupancy_p99"], s["padding_waste_p50"],
                  s.get("latency_p50_ms"), s.get("latency_p99_ms"),
                  s.get("mfu"), s.get("wa_pps"), s["esc_per_1k"],
+                 s.get("shed_per_1k"), s.get("quar_per_1k"),
                  s["retraces"], s["compiles"]]
                 for key, s in summary["serve"].items()]
         parts.append("\nserving\n" + _table(
             ["op/dtype", "batches", "problems", "occ_p50", "occ_p99",
              "waste_p50", "lat_p50_ms", "lat_p99_ms", "mfu", "wa_pps",
-             "esc/1k", "retraces", "compiles"],
+             "esc/1k", "shed/1k", "quar/1k", "retraces", "compiles"],
             rows))
     bench = summary["bench"]
     if bench["metrics"]:

@@ -33,6 +33,8 @@ Prometheus-style text instead of tables.
 from __future__ import annotations
 
 import json
+import threading
+from collections import deque
 
 from . import metrics as _metrics
 
@@ -40,10 +42,62 @@ from . import metrics as _metrics
 METRIC_DIRECTION = {
     "latency_p50_ms": "max", "latency_p99_ms": "max", "age_p99_ms": "max",
     "padding_waste_p50": "max", "esc_per_1k": "max", "retraces": "max",
-    "compiles": "max",
+    "compiles": "max", "shed_per_1k": "max", "quar_per_1k": "max",
     "occupancy_p50": "min", "occupancy_p99": "min", "wa_pps": "min",
     "mfu": "min", "problems": "min", "batches": "min",
 }
+
+
+def latency_budget_ms(budgets: dict, target: str = "*") -> float | None:
+    """The ``latency_p99_ms`` bound a budgets dict declares for
+    ``target`` (the live-control signal admission control consumes),
+    or None when the budgets declare no latency ceiling there."""
+    bound = (budgets.get(target) or {}).get("latency_p99_ms")
+    return float(bound) if isinstance(bound, (int, float)) else None
+
+
+class LatencyGovernor:
+    """Rolling-window latency controller: the SLO budget as a LIVE
+    control signal, not a post-hoc verdict.
+
+    The serving flush loop feeds every delivered request's
+    submit->result latency into :meth:`observe`; admission control asks
+    :meth:`overloaded` (rolling p99 over the declared ``budget_ms``
+    ceiling — backpressure tightens effective queue capacity) and
+    :meth:`estimate_wait_ms` (rolling p50 — the service-time estimate
+    that sheds deadline-doomed requests at admission instead of wasting
+    a batch slot).  With no budget declared the governor never reports
+    overload; with no observations yet it estimates zero wait —
+    admission stays permissive until there is data to act on."""
+
+    def __init__(self, budget_ms: float | None = None, window: int = 64):
+        self.budget_ms = budget_ms
+        self._lock = threading.Lock()
+        self._lat: deque = deque(maxlen=max(int(window), 1))
+
+    def observe(self, latency_ms: float) -> None:
+        """Record one delivered request's submit->result latency."""
+        with self._lock:
+            self._lat.append(float(latency_ms))
+
+    def p99_ms(self) -> float | None:
+        with self._lock:
+            vals = list(self._lat)
+        return _metrics.percentile(vals, 99)
+
+    def estimate_wait_ms(self) -> float:
+        """Expected admission->result wait (rolling p50; 0 cold)."""
+        with self._lock:
+            vals = list(self._lat)
+        return _metrics.percentile(vals, 50) or 0.0
+
+    def overloaded(self) -> bool:
+        """Is the rolling p99 over the declared budget?  The admission
+        queue halves its effective capacity while this holds."""
+        if self.budget_ms is None:
+            return False
+        p99 = self.p99_ms()
+        return p99 is not None and p99 > self.budget_ms
 
 
 def aggregate(records) -> dict:

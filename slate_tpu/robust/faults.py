@@ -71,6 +71,21 @@ from jax.experimental import io_callback
 SITES = ("input", "post_panel", "post_collective", "solve",
          "post_stage1", "post_chase", "post_secular", "post_backtransform",
          "post_rbt")
+#: HOST-side serving-layer chaos sites (docs/SERVING.md "Survival"):
+#: consumed via :func:`host_fire` by serve/server.py and serve/cache.py,
+#: never woven into a trace —
+#:
+#: ``serve_flush_delay``    the flush loop sleeps ``delay_s`` before
+#:                          executing (ages the batch: deadline sheds
+#:                          and watermark behavior become testable)
+#: ``serve_compile_stall``  the executable cache sleeps ``delay_s``
+#:                          before compiling a miss (a stuck compile:
+#:                          what the serving watchdog must catch)
+#: ``serve_cache_evict``    the executable cache drops every entry at
+#:                          the next lookup (mid-flight eviction: the
+#:                          recompile path under load)
+SERVE_SITES = ("serve_flush_delay", "serve_compile_stall",
+               "serve_cache_evict")
 KINDS = ("nan", "inf", "bitflip")
 
 # flipping exponent bit 6 of an O(1) value: finite, wildly wrong
@@ -93,11 +108,13 @@ class FaultPlan:
     # the whole array.  ``nb`` gives the block edge for 2D arrays.
     tile: tuple[int, int] | None = None
     nb: int = 0
+    # host-side serving sites only: how long the chaos sleep lasts
+    delay_s: float = 0.0
 
     def __post_init__(self):
-        if self.site not in SITES:
+        if self.site not in SITES and self.site not in SERVE_SITES:
             raise ValueError(f"unknown fault site {self.site!r}; "
-                             f"sites: {SITES}")
+                             f"sites: {SITES + SERVE_SITES}")
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"kinds: {KINDS}")
@@ -142,6 +159,61 @@ def inject(*plans: FaultPlan):
 
 def active(site: str) -> FaultPlan | None:
     return _ACTIVE.get(site)
+
+
+def host_fire(site: str) -> FaultPlan | None:
+    """Consume an active HOST-side serving chaos plan at ``site``.
+
+    Unlike :func:`maybe_corrupt` this never touches a trace: the
+    serving layer calls it from plain host code (the flush loop, the
+    executable cache) and acts on the returned plan (sleep, evict).
+    Transient plans fire at most once per :func:`inject` activation —
+    one stalled compile, not a permanently broken cache."""
+    if site not in SERVE_SITES:
+        return None
+    plan = _ACTIVE.get(site)
+    if plan is None:
+        return None
+    if plan.transient:
+        epoch = _PLAN_EPOCH.get(site, 0)
+        if (epoch, site) in _SPENT:
+            return None
+        _SPENT.add((epoch, site))
+    return plan
+
+
+def poisson_workload(seed: int, problems: int, rate_hz: float, sizes,
+                     nrhs: int = 2, dtype=np.float32,
+                     ops=("solve", "chol_solve", "least_squares_solve")):
+    """Deterministic seeded open-loop serving workload: ``problems``
+    mixed-size requests with exponential (Poisson-process) inter-arrival
+    gaps at ``rate_hz``.  Same seed -> same arrival times, sizes and
+    operand values, so overload/shed/quarantine behavior is reproducible
+    on CPU — the chaos harness's load generator (bench_serve_survival
+    and the survival tests replay it).
+
+    Returns ``[(t_arrival_s, op, a, b)]`` sorted by arrival; matrices
+    are well-conditioned (diagonally dominated / SPD-shifted), so every
+    admitted request should serve healthy unless chaos intervenes."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / max(rate_hz, 1e-9),
+                                         size=problems))
+    out = []
+    for i in range(problems):
+        n = int(sizes[i % len(sizes)])
+        op = ops[i % len(ops)]
+        if op == "least_squares_solve":
+            a = rng.standard_normal((n + 8, n)).astype(dtype)
+            b = rng.standard_normal((n + 8, nrhs)).astype(dtype)
+        else:
+            a = rng.standard_normal((n, n)).astype(dtype)
+            if op == "chol_solve":
+                a = (a @ a.T / n + np.eye(n, dtype=dtype)).astype(dtype)
+            else:
+                a = a + np.eye(n, dtype=dtype) * 4.0
+            b = rng.standard_normal((n, nrhs)).astype(dtype)
+        out.append((float(arrivals[i]), op, a, b))
+    return out
 
 
 def _strike_flat(flat, size: int, plan: FaultPlan):

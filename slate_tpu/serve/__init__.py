@@ -12,10 +12,16 @@ cores, with
 - a persistent compiled-executable cache with donated steady-state
   buffers (:mod:`cache` — the only module allowed to compile,
   slate-lint SEAM012),
-- a ``Server`` front end emitting one obs record per batch
-  (:mod:`server`).
+- deadline-aware admission control with SLO-driven backpressure and
+  typed overflow policies (:mod:`admission`),
+- a ``Server`` front end emitting one obs record per batch, with an
+  optional background flush loop, wedge watchdog, and poison-request
+  quarantine — the survival layer of docs/SERVING.md (:mod:`server`).
 """
 
+from .admission import (OVERFLOW_POLICIES, AdmissionConfig, AdmissionQueue,
+                        SlateServeError, SlateServeOverloadError,
+                        SlateServeTimeoutError, Ticket)
 from .batched import (CORES, chol_solve_core, least_squares_core,
                       make_batched, solve_core)
 from .bucket import (BucketLadder, default_ladder, geometric_ladder,
@@ -25,8 +31,10 @@ from .cache import ExecutableCache, default_cache, options_fingerprint
 from .server import SERVE_OPS, Request, Result, Server
 
 __all__ = [
-    "BucketLadder", "CORES", "ExecutableCache", "Request", "Result",
-    "SERVE_OPS", "Server", "chol_solve_core", "default_cache",
+    "AdmissionConfig", "AdmissionQueue", "BucketLadder", "CORES",
+    "ExecutableCache", "OVERFLOW_POLICIES", "Request", "Result",
+    "SERVE_OPS", "Server", "SlateServeError", "SlateServeOverloadError",
+    "SlateServeTimeoutError", "Ticket", "chol_solve_core", "default_cache",
     "default_ladder", "geometric_ladder", "least_squares_buckets",
     "least_squares_core", "make_batched", "next_pow2",
     "options_fingerprint", "pad_rows", "pad_square", "pad_tall",

@@ -33,6 +33,7 @@ import jax
 
 from ..obs import sentinel as _sentinel
 from ..options import Options
+from ..robust import faults as _faults
 from . import batched as _batched
 
 
@@ -88,13 +89,23 @@ class ExecutableCache:
         dtype = str(jax.numpy.dtype(dtype))
         key = (op, tuple(int(s) for s in bucket_shape), dtype,
                options_fingerprint(opts), int(batch))
+        # chaos site: a mid-flight eviction forces the recompile path —
+        # the serving layer must survive losing its warm executables
+        if _faults.host_fire("serve_cache_evict") is not None:
+            self.clear()
         with self._lock:
             exe = self._exes.get(key)
             if exe is not None:
                 self._hits += 1
                 return exe, True
         # compile OUTSIDE the lock (it can take seconds); a racing
-        # duplicate compile is wasted work, not a correctness problem
+        # duplicate compile is wasted work, not a correctness problem —
+        # which is also where the chaos compile-stall site lives: the
+        # serving watchdog must catch a wedged compile, and a stall
+        # under the lock would be the CON003 bug class, not a test
+        stall = _faults.host_fire("serve_compile_stall")
+        if stall is not None:
+            time.sleep(stall.delay_s)
         t0 = time.perf_counter()
         exe = self._compile(op, key[1], dtype, int(batch), opts)
         dt_ms = (time.perf_counter() - t0) * 1e3

@@ -1,6 +1,6 @@
 """The serving front end: submit mixed-size solves, drain bucketed batches.
 
-Usage::
+Synchronous usage (unchanged)::
 
     from slate_tpu import serve
 
@@ -10,27 +10,51 @@ Usage::
     t2 = srv.submit("least_squares_solve", a2, b2)
     results = srv.drain()                         # [Result] in submit order
 
-Each ``drain`` groups pending requests by ``(op, dtype, bucket)``,
+Survival-layer usage (the background front door)::
+
+    srv = serve.Server(admission=serve.AdmissionConfig(
+        max_queue=64, overflow="shed_oldest", default_deadline_ms=250,
+        slo_budget_ms=250))
+    srv.start()                                   # flush loop + watchdog
+    t = srv.submit("solve", a, b)                 # admission-controlled
+    x = t.result(timeout=1.0).x                   # sticky typed errors
+    srv.shutdown()                                # drains or fails loudly
+
+Each flush groups pending requests by ``(op, dtype, bucket)``,
 identity-pads every problem to its bucket (bucket.py), rounds the
 batch count up to a power of two with identity filler slots, runs the
 bucket's cached executable (cache.py — compiled once, B donated), and
 unpacks per-problem results, ``HealthInfo`` and escalation flags.
 
-One ``slate-obs-v1`` record of kind ``serve_batch`` is emitted per
-executed batch (obs.events.emit_serve_batch) carrying bucket occupancy,
-padding waste, escalations, executable-cache stats and the retrace
-delta observed across the execution — the fields ``python -m
-slate_tpu.obs`` aggregates into the serving table.
+Survival properties (docs/SERVING.md "Survival"):
 
-The server is also a flight recorder: every request is stamped at
-submit, so each ``serve_batch`` event additionally carries
-``queue_depth`` (pending requests when drain started), per-problem
-``age_at_flush_ms`` (submit -> drain start) and ``latency_ms``
-(submit -> result materialized) — the tail-latency inputs
-``obs.slo`` aggregates into p50/p99 verdicts.  Under ``obs.timing()``
-the batch also reports ``device_ms`` (dispatch -> device-ready) and a
-waste-adjusted ``mfu`` priced over LIVE problem flops only
-(obs.flops.serve_flops), so padding can never inflate utilization.
+- **admission control / backpressure** — submit goes through the
+  bounded :class:`~slate_tpu.serve.admission.AdmissionQueue`: overflow
+  policy, per-request deadlines, and SLO-budget backpressure (the
+  rolling-latency governor) decide at admission; shed requests carry
+  typed errors, never silence.
+- **background flush loop** — a daemon thread batches by occupancy /
+  age / deadline-slack watermarks while callers keep submitting; a
+  watchdog daemon declares a flush wedged after ``watchdog_timeout_s``
+  and fails every pending request loudly with
+  :class:`SlateServeTimeoutError` instead of blocking callers forever.
+  Tickets are first-write-wins, so a wedged flush that later limps
+  home cannot double-answer.
+- **poison quarantine** — a problem that exhausts the in-graph
+  escalation ladder (``escalated`` with unhealthy ``HealthInfo``) is
+  retried at most once in a fresh batch, then quarantined to a
+  singleton slow path; its neighbors' batches never carry it again.
+- **sticky errors** — a failed flush stores its typed error on every
+  affected ticket AND on the server; the next ``drain()`` re-raises it
+  even when the queue is already empty.
+
+One ``slate-obs-v1`` record of kind ``serve_batch`` is emitted per
+executed batch; sheds and quarantines emit ``serve_shed`` /
+``serve_quarantine`` records (obs/events.py) feeding the ``shed/1k``
+and ``quar/1k`` columns of the ``python -m slate_tpu.obs`` serving
+table.  The flight-recorder fields (queue depth, per-problem
+``age_at_flush_ms`` / ``latency_ms``, device-time ``mfu`` under
+``obs.timing()``) are unchanged from the synchronous server.
 """
 
 from __future__ import annotations
@@ -43,11 +67,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..exceptions import SlateServeError, SlateServeTimeoutError
 from ..obs import events as _events
 from ..obs import flops as _flops
 from ..obs import sentinel as _sentinel
 from ..options import Options
+from ..robust import faults as _faults
 from ..robust.health import HealthInfo
+from . import admission as _admission
 from . import bucket as _bucket
 from . import cache as _cache
 
@@ -55,12 +82,18 @@ SERVE_OPS = ("solve", "chol_solve", "least_squares_solve")
 
 
 class Request(NamedTuple):
-    """One pending problem: ``op`` in SERVE_OPS, dense ``a``/``b``,
-    and the flight-recorder submit stamp (perf_counter seconds)."""
+    """One pending problem: ``op`` in SERVE_OPS, dense ``a``/``b``, the
+    flight-recorder submit stamp (perf_counter seconds), the admission
+    ticket, the absolute deadline (perf_counter seconds, None = never),
+    and how many batched attempts have come back poison (strikes: one
+    earns the fresh-batch retry, two the quarantine slow path)."""
     op: str
     a: np.ndarray
     b: np.ndarray
     t_submit: float = 0.0
+    ticket: object = None
+    deadline: float | None = None
+    retries: int = 0
 
 
 class Result(NamedTuple):
@@ -78,6 +111,13 @@ def _as_2d(x, name: str) -> np.ndarray:
     return x
 
 
+def _poison(req: Request, res: Result) -> bool:
+    """Did this problem exhaust the in-graph escalation ladder?  The
+    safety rung ran AND still reports unhealthy — the per-request
+    analog of a tile fault the recovery ladder could not repair."""
+    return bool(res.escalated) and not bool(res.health.ok)
+
+
 class Server:
     """Shape-bucketed batch server over the vmap-clean solve cores.
 
@@ -85,19 +125,33 @@ class Server:
     fingerprint); ``ladder`` overrides the bucket ladder (default:
     tuned rungs when the plan cache has them, else geometric);
     ``cache`` shares or isolates the executable store (default: the
-    process-wide cache)."""
+    process-wide cache); ``admission`` configures the survival layer
+    (default :class:`AdmissionConfig`: effectively the old unbounded
+    synchronous behavior — queue of 256, no deadlines, no loop until
+    :meth:`start`); ``governor`` injects a shared latency governor."""
 
     def __init__(self, opts: Options | None = None,
                  ladder: _bucket.BucketLadder | None = None,
-                 cache: _cache.ExecutableCache | None = None):
+                 cache: _cache.ExecutableCache | None = None,
+                 admission: _admission.AdmissionConfig | None = None,
+                 governor=None):
         self.opts = dict(opts or {})
         self._ladder = ladder
         self.cache = cache if cache is not None else _cache.default_cache()
-        # submit/drain may come from different threads (a web front end
-        # submitting while a drain loop flushes); the queue swap must be
-        # atomic or tickets tear
+        self.admission = admission or _admission.AdmissionConfig()
+        self.queue = _admission.AdmissionQueue(self.admission, governor)
+        # flush/watchdog/lifecycle state shared between the submitting
+        # threads, the flush loop and the watchdog; the registry
+        # declares _lock's guards (rules/concurrency.py)
         self._lock = threading.Lock()
-        self._pending: list[Request] = []
+        self._inflight: list = []          # requests in the running flush
+        self._flush_deadline: float | None = None   # watchdog deadline
+        self._wedged: Exception | None = None       # sticky watchdog error
+        self._flush_error: Exception | None = None  # sticky flush error
+        self._quarantined = 0
+        self._flusher: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
+        self._stop_event = threading.Event()        # self-synchronized
 
     # ------------------------------------------------------------ intake
 
@@ -106,8 +160,14 @@ class Server:
             return self._ladder
         return _bucket.default_ladder(str(jnp.dtype(dtype)))
 
-    def submit(self, op: str, a, b) -> int:
-        """Queue one problem; returns its ticket (index into drain())."""
+    def submit(self, op: str, a, b,
+               deadline_ms: float | None = None) -> _admission.Ticket:
+        """Queue one problem through admission control; returns its
+        :class:`~slate_tpu.serve.admission.Ticket` (an int: the index
+        into a synchronous ``drain()``'s results; ``ticket.result()``
+        is the durable interface).  ``deadline_ms`` overrides the
+        config default; a request that would age out is shed HERE with
+        a typed error, not silently dropped in a batch."""
         if op not in SERVE_OPS:
             raise ValueError(f"serve: unknown op {op!r} "
                              f"(known: {SERVE_OPS})")
@@ -125,15 +185,216 @@ class Server:
         if b.shape[0] != a.shape[0]:
             raise ValueError(f"serve: A {a.shape} / B {b.shape} row "
                              "mismatch")
-        with self._lock:
-            self._pending.append(Request(op, a, b, time.perf_counter()))
-            return len(self._pending) - 1
+        wedge = self.wedged()
+        if wedge is not None:
+            raise SlateServeTimeoutError(
+                f"serve: server is wedged ({wedge}); restart it",
+                reason="wedged")
+        now = time.perf_counter()
+        if deadline_ms is None:
+            deadline_ms = self.admission.default_deadline_ms
+        deadline = None if deadline_ms is None else now + deadline_ms / 1e3
+        dtype = str(a.dtype)
+
+        def build(ticket):
+            return Request(op, a, b, now, ticket, deadline, 0)
+
+        try:
+            ticket, victims = self.queue.offer(build, deadline, now)
+        except SlateServeTimeoutError as e:
+            self._emit_shed(op, dtype, e.reason, 0.0)
+            raise
+        except SlateServeError as e:
+            self._emit_shed(op, dtype,
+                            f"overflow_{getattr(e, 'policy', 'reject')}",
+                            0.0)
+            raise
+        for v in victims:
+            err = _admission.SlateServeOverloadError(
+                "serve: shed (oldest queued) to admit new work under "
+                "overload", policy="shed_oldest")
+            if v.ticket is not None:
+                v.ticket.fail(err)
+            self._emit_shed(v.op, str(v.a.dtype), "overflow_shed_oldest",
+                            (now - v.t_submit) * 1e3)
+        return ticket
 
     def serve_batch(self, requests) -> list:
         """Synchronous convenience: submit every (op, a, b) and drain."""
         for op, a, b in requests:
             self.submit(op, a, b)
         return self.drain()
+
+    def _emit_shed(self, op: str, dtype: str, reason: str,
+                   age_ms: float) -> None:
+        _events.emit_serve_shed({
+            "op": op, "dtype": dtype, "reason": reason,
+            "age_ms": round(age_ms, 3),
+            "queue_depth": self.queue.depth(),
+        })
+
+    # ------------------------------------------------- background loop
+
+    def start(self) -> None:
+        """Start the background flush loop and its watchdog (both
+        daemon threads; idempotent while they are alive)."""
+        with self._lock:
+            if self._flusher is not None and self._flusher.is_alive():
+                return
+            self._stop_event.clear()
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="slate-serve-flush",
+                daemon=True)
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="slate-serve-watchdog",
+                daemon=True)
+            self._flusher.start()
+            self._watchdog.start()
+
+    def running(self) -> bool:
+        with self._lock:
+            return (self._flusher is not None
+                    and self._flusher.is_alive())
+
+    def wedged(self) -> Exception | None:
+        """The sticky watchdog error, if the server is wedged."""
+        with self._lock:
+            return self._wedged
+
+    def health_info(self) -> dict:
+        """Front-door health: admission stats, loop/wedge state, and
+        the quarantine count — what a load balancer would scrape."""
+        with self._lock:
+            wedged = self._wedged
+            inflight = len(self._inflight)
+            quarantined = self._quarantined
+        return {
+            "queue": self.queue.stats(),
+            "inflight": inflight,
+            "running": self.running(),
+            "wedged": None if wedged is None else str(wedged),
+            "quarantined": quarantined,
+            "slo_p99_ms": self.queue.governor.p99_ms(),
+            "slo_budget_ms": self.queue.governor.budget_ms,
+        }
+
+    def shutdown(self, drain: bool = True,
+                 timeout_s: float | None = None) -> None:
+        """Stop the loop and settle every pending request: drain them
+        (default) or fail them loudly with a typed shutdown error —
+        never leave a ticket unsettled or a daemon thread parked.  A
+        wedged flush thread cannot be killed; its requests were already
+        failed by the watchdog and the daemon thread dies with the
+        process."""
+        with self._lock:
+            flusher, watchdog = self._flusher, self._watchdog
+        self._stop_event.set()
+        self.queue.kick()
+        join_s = (timeout_s if timeout_s is not None
+                  else self.admission.watchdog_timeout_s + 1.0)
+        for t in (flusher, watchdog):
+            if t is not None and t is not threading.current_thread():
+                t.join(join_s)
+        stranded = self.queue.close("shutdown")
+        if stranded:
+            if drain and self.wedged() is None:
+                results, err = self._execute(stranded)
+                if err is not None:
+                    with self._lock:
+                        self._flush_error = err
+            else:
+                err = SlateServeTimeoutError(
+                    f"serve: shutdown with {len(stranded)} request(s) "
+                    f"still pending", reason="shutdown")
+                self.queue.note_shed(len(stranded))
+                for r in stranded:
+                    if r.ticket is not None:
+                        r.ticket.fail(err)
+                    self._emit_shed(
+                        r.op, str(r.a.dtype), "shutdown",
+                        (time.perf_counter() - r.t_submit) * 1e3)
+        with self._lock:
+            self._flusher = None
+            self._watchdog = None
+
+    def _flush_loop(self) -> None:
+        poll_s = max(self.admission.max_batch_delay_ms / 2e3, 1e-3)
+        while not self._stop_event.is_set():
+            if self.queue.flush_due():
+                self._flush_once()
+            else:
+                self.queue.park(poll_s)
+                if not self.queue.flush_due():
+                    self._stop_event.wait(poll_s)
+
+    def _flush_once(self) -> None:
+        live, expired = self.queue.take_all()
+        self._shed_expired(expired)
+        if not live:
+            return
+        with self._lock:
+            self._inflight = live
+            self._flush_deadline = (time.perf_counter()
+                                    + self.admission.watchdog_timeout_s)
+        err = None
+        try:
+            _, err = self._execute(live)
+        except Exception as e:          # never kill the loop: stickify
+            err = e
+            for r in live:
+                if r.ticket is not None:
+                    r.ticket.fail(e)
+        finally:
+            with self._lock:
+                self._inflight = []
+                self._flush_deadline = None
+        if err is not None:
+            with self._lock:
+                self._flush_error = err
+
+    def _watchdog_loop(self) -> None:
+        poll_s = min(max(self.admission.watchdog_timeout_s / 8.0, 1e-3),
+                     0.25)
+        while not self._stop_event.is_set():
+            with self._lock:
+                deadline = self._flush_deadline
+            if deadline is not None and time.perf_counter() > deadline:
+                self._declare_wedged()
+            self._stop_event.wait(poll_s)
+
+    def _declare_wedged(self) -> None:
+        err = SlateServeTimeoutError(
+            f"serve: flush exceeded watchdog_timeout_s="
+            f"{self.admission.watchdog_timeout_s} (stuck compile or "
+            f"device hang) — failing pending requests", reason="watchdog")
+        with self._lock:
+            if self._flush_deadline is None:    # flush just completed
+                return
+            self._wedged = err
+            inflight, self._inflight = self._inflight, []
+            self._flush_deadline = None
+        stranded = self.queue.close("wedged")
+        self.queue.note_shed(len(inflight) + len(stranded))
+        now = time.perf_counter()
+        for r in inflight + stranded:
+            if r.ticket is not None:
+                r.ticket.fail(err)
+            self._emit_shed(r.op, str(r.a.dtype), "watchdog",
+                            (now - r.t_submit) * 1e3)
+
+    def _shed_expired(self, expired) -> None:
+        if not expired:
+            return
+        self.queue.note_shed(len(expired))
+        now = time.perf_counter()
+        for r in expired:
+            err = SlateServeTimeoutError(
+                "serve: request deadline expired while queued — shed at "
+                "flush", reason="deadline")
+            if r.ticket is not None:
+                r.ticket.fail(err)
+            self._emit_shed(r.op, str(r.a.dtype), "deadline",
+                            (now - r.t_submit) * 1e3)
 
     # ------------------------------------------------------------- drain
 
@@ -145,24 +406,119 @@ class Server:
         return _bucket.solve_buckets(lad, req.a.shape[0], req.b.shape[1])
 
     def drain(self) -> list:
-        """Execute every pending request; results in submit order."""
+        """Execute every pending request; results in submit order.
+
+        Errors are never silent: a sticky error from a failed
+        background flush is re-raised HERE first (then cleared), even
+        when the queue is already empty; a group that fails during this
+        drain stores the typed error on every affected ticket and
+        drain re-raises the first one after every group has been
+        attempted."""
         with self._lock:
-            pending, self._pending = self._pending, []
-        if not pending:
+            err, self._flush_error = self._flush_error, None
+        if err is not None:
+            raise err
+        live, expired = self.queue.take_all()
+        self._shed_expired(expired)
+        if not live:
             return []
-        t_flush = time.perf_counter()
-        groups: dict = {}
-        for ticket, req in enumerate(pending):
-            key = (req.op, str(req.a.dtype), self._bucket_of(req))
-            groups.setdefault(key, []).append((ticket, req))
-        results: list = [None] * len(pending)
-        for key in sorted(groups, key=repr):
-            op, dtype, shape = key
-            for ticket, res in self._run_group(op, dtype, shape,
-                                               groups[key], t_flush,
-                                               len(pending)):
-                results[ticket] = res
+        results, err = self._execute(live)
+        if err is not None:
+            raise err
         return results
+
+    def _execute(self, pending):
+        """Run every request of one flush: group, execute, retry
+        poisons once in a fresh batch, quarantine repeat offenders to a
+        singleton slow path, deliver to tickets.  Returns ``(results,
+        first_error)`` with results aligned to ``pending`` (None in a
+        failed slot — its ticket holds the sticky error)."""
+        plan = _faults.host_fire("serve_flush_delay")
+        if plan is not None:
+            time.sleep(plan.delay_s)
+        t_flush = time.perf_counter()
+        results: list = [None] * len(pending)
+        first_err: Exception | None = None
+
+        def deliver(idx: int, res: Result) -> None:
+            results[idx] = res
+            req = pending[idx]
+            self.queue.governor.observe(
+                (time.perf_counter() - req.t_submit) * 1e3)
+            if req.ticket is not None:
+                req.ticket.deliver(res)
+
+        def run_pass(members_by_idx, queue_depth):
+            """One grouped pass; returns the poison list [(idx, req)]."""
+            nonlocal first_err
+            reqs = dict(members_by_idx)
+            groups: dict = {}
+            for idx, req in members_by_idx:
+                key = (req.op, str(req.a.dtype), self._bucket_of(req))
+                groups.setdefault(key, []).append((idx, req))
+            poisons = []
+            for key in sorted(groups, key=repr):
+                op, dtype, shape = key
+                try:
+                    out = self._run_group(op, dtype, shape, groups[key],
+                                          t_flush, queue_depth)
+                except Exception as e:
+                    err = e if isinstance(e, SlateServeError) else \
+                        SlateServeError(
+                            f"serve: flush failed for {op}/{dtype} "
+                            f"bucket {shape}: {e}")
+                    err.__cause__ = e if err is not e else None
+                    first_err = first_err or err
+                    for idx, req in groups[key]:
+                        if req.ticket is not None:
+                            req.ticket.fail(err)
+                    continue
+                for idx, res in out:
+                    req = reqs[idx]
+                    if _poison(req, res):
+                        # withhold the bad result: first strike earns the
+                        # fresh-batch retry, second goes to quarantine
+                        poisons.append((idx, req._replace(
+                            retries=req.retries + 1)))
+                    else:
+                        deliver(idx, res)
+            return poisons
+
+        poisons = run_pass(list(enumerate(pending)), len(pending))
+        # the at-most-once fresh-batch retry: poisons ride together,
+        # never again with the healthy requests they degraded
+        repeat = run_pass(poisons, len(poisons)) if poisons else []
+        for idx, req in repeat:
+            # second strike: quarantine to the singleton slow path and
+            # deliver whatever it produces — HealthInfo reports the rest
+            self._quarantine(idx, req, t_flush, deliver)
+        return results, first_err
+
+    def _quarantine(self, idx: int, req: Request, t_flush: float,
+                    deliver) -> None:
+        with self._lock:
+            self._quarantined += 1
+        key = (req.op, str(req.a.dtype), self._bucket_of(req))
+        op, dtype, shape = key
+        t0 = time.perf_counter()
+        try:
+            ((_, res),) = self._run_group(op, dtype, shape, [(idx, req)],
+                                          t_flush, 1)
+        except Exception as e:
+            err = e if isinstance(e, SlateServeError) else \
+                SlateServeError(f"serve: quarantine slow path failed for "
+                                f"{op}/{dtype}: {e}")
+            if req.ticket is not None:
+                req.ticket.fail(err)
+            return
+        _events.emit_serve_quarantine({
+            "op": op, "dtype": dtype, "bucket": list(shape),
+            "reason": "escalation_exhausted",
+            "retries": max(req.retries - 1, 0),   # fresh-batch retries spent
+            "ok": bool(res.health.ok),
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+        deliver(idx, res)
 
     def _run_group(self, op: str, dtype: str, shape: tuple, members,
                    t_flush: float, queue_depth: int):

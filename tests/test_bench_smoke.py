@@ -108,6 +108,31 @@ def test_serve_ragged_emits_both_routes(bench, capsys):
     assert by_metric["serve_ragged_speedup"]["value"] > 0
 
 
+def test_serve_survival_emits_survival_metrics(bench, capsys):
+    """bench_serve_survival replays a Poisson arrival stream against a
+    live background-flush Server and self-emits five lines: throughput,
+    admitted p99, shed and quarantine rates, and the SLO verdict."""
+    bench.bench_serve_survival(problems=8, rate_hz=2000.0, nrhs=2,
+                               sizes=(8, 16), budget_ms=60000.0)
+    by_metric = {ln["metric"]: ln for ln in _lines(capsys)}
+    assert set(by_metric) == {
+        "serve_survival_problems_per_s",
+        "serve_survival_latency_p99_ms",
+        "serve_survival_shed_per_1k",
+        "serve_survival_quar_per_1k",
+        "serve_survival_slo_pass"}
+    pps = by_metric["serve_survival_problems_per_s"]
+    assert pps["schema"] == "slate-bench-v1" and "chip" in pps
+    assert pps["unit"] == "problems/s" and pps["value"] >= 0
+    assert by_metric["serve_survival_latency_p99_ms"]["unit"] == "ms"
+    for rate in ("shed_per_1k", "quar_per_1k"):
+        line = by_metric[f"serve_survival_{rate}"]
+        assert line["unit"] == "per_1k"
+        assert 0.0 <= line["value"] <= 1000.0
+    gate = by_metric["serve_survival_slo_pass"]
+    assert gate["unit"] == "bool" and gate["value"] in (0, 1)
+
+
 def test_step_lists_cover_every_metric(bench):
     """Both step lists must include the RBT speculation metric and stay
     callable (functions exist, kwargs are their signature's names)."""
@@ -119,6 +144,7 @@ def test_step_lists_cover_every_metric(bench):
         assert "bench_posv_abft" in names
         assert "bench_serve_mixed" in names
         assert "bench_serve_ragged" in names
+        assert "bench_serve_survival" in names
         for fn, kwargs in steps:
             sig = inspect.signature(fn)
             assert set(kwargs) == set(sig.parameters)

@@ -1578,7 +1578,68 @@ def test_con001_mutation_of_real_server_is_caught(tmp_path):
                     {"slate_tpu/serve/server.py": mutated})
     fs = lint(bad, {"CON001"})
     assert fs and all(f.rule == "CON001" for f in fs)
-    assert all("_pending" in f.message for f in fs)
+    guards = ("_inflight", "_flush_deadline", "_wedged", "_flush_error",
+              "_quarantined", "_flusher", "_watchdog")
+    assert all(any(g in f.message for g in guards) for f in fs)
+
+
+def test_con001_mutation_of_real_admission_queue_is_caught(tmp_path):
+    """Same acceptance mutation for the survival layer's intake: unlock
+    take_all()'s item swap in the real admission.py and CON001 fires on
+    the queue state."""
+    real = (REPO / "slate_tpu/serve/admission.py").read_text()
+    good = mini_repo(tmp_path / "good",
+                     {"slate_tpu/serve/admission.py": real})
+    assert lint(good, {"CON001"}) == []
+    locked = ("        with self._lock:\n"
+              "            items, self._items = self._items, []")
+    assert locked in real
+    mutated = real.replace(
+        locked, "        if True:\n"
+                "            items, self._items = self._items, []", 1)
+    bad = mini_repo(tmp_path / "bad",
+                    {"slate_tpu/serve/admission.py": mutated})
+    fs = lint(bad, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    assert all("_items" in f.message for f in fs)
+
+
+ADMISSION_FIXTURE = """\
+import threading
+
+
+class AdmissionQueue:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._items = []
+        self._shed = 0
+        self._closed = None
+
+    def depth(self):
+        with self._lock:
+            return len(self._items)
+"""
+
+
+def test_con001_fires_on_unlocked_queue_state(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/admission.py": ADMISSION_FIXTURE + (
+            "\n"
+            "    def sneak(self):\n"
+            "        self._shed += 1\n")})
+    fs = lint(root, {"CON001"})
+    assert [f.rule for f in fs] == ["CON001"]
+    assert "_shed" in fs[0].message
+
+
+def test_con001_silent_on_locked_queue_state(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/admission.py": ADMISSION_FIXTURE + (
+            "\n"
+            "    def sneak(self):\n"
+            "        with self._lock:\n"
+            "            self._shed += 1\n")})
+    assert lint(root, {"CON001"}) == []
 
 
 def test_con002_fires_on_lock_order_inversion(tmp_path, monkeypatch):

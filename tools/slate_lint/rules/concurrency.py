@@ -1,11 +1,14 @@
 """Lock-discipline rules (CON0xx), driven by a declared registry of
 guarded state.
 
-The framework has exactly three pieces of cross-thread mutable state —
-the server's pending queue, the executable cache's store/counters, and
-the obs event sinks — each guarded by one ``threading.Lock``.  Rather
-than guess at lock/state association, the registry below DECLARES it:
-one :class:`LockSpec` per lock names the module, the owning class (None
+The framework's cross-thread mutable state — the server's flush/wedge
+bookkeeping, the admission queue and its tickets, the SLO latency
+governor, the executable cache's store/counters, and the obs event
+sinks — is each guarded by one lock (a ``threading.Lock`` or, for the
+admission queue, a ``Condition``, which the rules treat identically:
+``with self._lock:`` acquires either).  Rather than guess at
+lock/state association, the registry below DECLARES it: one
+:class:`LockSpec` per lock names the module, the owning class (None
 for module-level locks), the lock's attribute/global name, and the
 state names it guards.  Growing a new locked subsystem means adding one
 registry line; the rules then hold it to the same discipline.
@@ -60,7 +63,14 @@ class LockSpec(NamedTuple):
 #: format).  One line per lock; CON001-CON003 enforce the discipline.
 LOCK_REGISTRY: tuple[LockSpec, ...] = (
     LockSpec("slate_tpu/serve/server.py", "Server", "_lock",
-             ("_pending",)),
+             ("_inflight", "_flush_deadline", "_wedged", "_flush_error",
+              "_quarantined", "_flusher", "_watchdog")),
+    LockSpec("slate_tpu/serve/admission.py", "AdmissionQueue", "_lock",
+             ("_items", "_next_id", "_admitted", "_shed", "_closed")),
+    LockSpec("slate_tpu/serve/admission.py", "Ticket", "_lock",
+             ("_value", "_error")),
+    LockSpec("slate_tpu/obs/slo.py", "LatencyGovernor", "_lock",
+             ("_lat",)),
     LockSpec("slate_tpu/serve/cache.py", "ExecutableCache", "_lock",
              ("_exes", "_hits", "_misses", "_compile_ms")),
     LockSpec("slate_tpu/obs/events.py", None, "_LOCK",
