@@ -771,6 +771,82 @@ def bench_serve_survival(problems, rate_hz, nrhs, sizes, budget_ms):
                       "unit": "bool", "n": problems}), flush=True)
 
 
+def bench_potrf_ooc(n, nb, iters):
+    """Out-of-core Cholesky throughput (durability PR): the host-resident
+    TileMap streaming path — every panel round-trips host<->device with
+    the next left panel prefetched behind the trailing update — against
+    the in-core potrf at the same size, so the line prices what the
+    host-offload axis costs.  Emits its own lines: the absolute GFLOP/s
+    of the streaming factorization and its slowdown vs in-core."""
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = a @ a.T + n * np.eye(n, dtype=np.float32)
+    flops = n ** 3 / 3.0
+    _PROGRESS["phase"] = "compile"
+    st.potrf_ooc(spd, nb=nb)                    # compile + warmup
+    A = st.SymmetricMatrix(TileStorage.from_dense(spd, nb, nb),
+                           uplo=st.Uplo.Lower)
+    st.potrf(A)
+    _PROGRESS["phase"] = "run"
+    t_ooc = min(_walltime(lambda: st.potrf_ooc(spd, nb=nb))
+                for _ in range(iters))
+    t_inc = min(_walltime(lambda: np.asarray(st.potrf(A).to_dense()))
+                for _ in range(iters))
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "durability_potrf_ooc_gflops",
+                      "value": round(flops / t_ooc / 1e9, 2),
+                      "unit": "GFLOP/s", "n": n}), flush=True)
+    print(json.dumps({**base, "metric": "durability_potrf_ooc_slowdown",
+                      "value": round(t_ooc / max(t_inc, 1e-9), 3),
+                      "unit": "x", "n": n}), flush=True)
+
+
+def bench_checkpoint_overhead(n, nb, iters):
+    """Panel-boundary checkpoint cost (durability PR): the same
+    out-of-core Cholesky with a CheckpointManager snapshotting at EVERY
+    panel step (the worst-case cadence) vs checkpointing off.  Reports
+    the relative overhead and the per-snapshot wall cost — the number a
+    user trades against their preemption rate when picking ``every``."""
+    import shutil
+    import tempfile
+    from slate_tpu.robust import CheckpointManager
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    spd = a @ a.T + n * np.eye(n, dtype=np.float32)
+    nsteps = -(-n // nb)
+    _PROGRESS["phase"] = "compile"
+    st.potrf_ooc(spd, nb=nb)                    # compile + warmup
+    _PROGRESS["phase"] = "run"
+    t_off = min(_walltime(lambda: st.potrf_ooc(spd, nb=nb))
+                for _ in range(iters))
+    t_on = []
+    for _ in range(iters):
+        d = tempfile.mkdtemp(prefix="slate_bench_ckpt_")
+        try:
+            cm = CheckpointManager(d, every=1)
+            t_on.append(_walltime(
+                lambda: st.potrf_ooc(spd, nb=nb, checkpoint=cm)))
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    t_on = min(t_on)
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "durability_ckpt_overhead_pct",
+                      "value": round(100.0 * (t_on - t_off)
+                                     / max(t_off, 1e-9), 2),
+                      "unit": "%", "n": n}), flush=True)
+    print(json.dumps({**base, "metric": "durability_ckpt_save_ms",
+                      "value": round(1e3 * (t_on - t_off)
+                                     / max(nsteps, 1), 3),
+                      "unit": "ms", "n": n}), flush=True)
+
+
+def _walltime(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 QUICK_STEPS = [
     (bench_gemm, dict(n=512, nb=128, iters=4)),
     (bench_posv, dict(n=768, nb=128, nrhs=64, iters=2)),
@@ -791,6 +867,8 @@ QUICK_STEPS = [
     (bench_serve_ragged, dict(problems=12, nrhs=4, reps=2, bucket=32)),
     (bench_serve_survival, dict(problems=24, rate_hz=400.0, nrhs=4,
                                 sizes=(24, 48), budget_ms=5000.0)),
+    (bench_potrf_ooc, dict(n=192, nb=64, iters=2)),
+    (bench_checkpoint_overhead, dict(n=192, nb=64, iters=2)),
 ]
 
 FULL_STEPS = [
@@ -815,6 +893,8 @@ FULL_STEPS = [
     (bench_serve_ragged, dict(problems=48, nrhs=16, reps=3, bucket=256)),
     (bench_serve_survival, dict(problems=192, rate_hz=800.0, nrhs=16,
                                 sizes=(48, 96, 160), budget_ms=2000.0)),
+    (bench_potrf_ooc, dict(n=4096, nb=512, iters=3)),
+    (bench_checkpoint_overhead, dict(n=4096, nb=512, iters=3)),
 ]
 
 

@@ -39,11 +39,14 @@ from .drivers.blas3 import (  # noqa: F401
 from .drivers.auxiliary import (  # noqa: F401
     add, col_norms, copy, norm, redistribute, scale, scale_row_col, set,
 )
-from .drivers.cholesky import posv, potrf, potri, potrs  # noqa: F401
+from .drivers.cholesky import (  # noqa: F401
+    posv, potrf, potrf_ooc, potri, potrs,
+)
 from .drivers.inverse import trtri, trtrm  # noqa: F401
 from .drivers.lu import (  # noqa: F401
-    LUFactors, RBTFactors, gesv, gesv_nopiv, getrf, getrf_nopiv, getrf_rbt,
-    getrf_tntpiv, getri, getriOOP, getrs,
+    LUFactors, OocLUFactors, RBTFactors, gesv, gesv_nopiv, getrf,
+    getrf_nopiv, getrf_ooc, getrf_rbt, getrf_tntpiv, getri, getriOOP,
+    getrs,
 )
 from .drivers.qr import (  # noqa: F401
     CAQRFactors, LQFactors, QRFactors, cholqr, gelqf, gels, gels_cholqr,

@@ -18,6 +18,17 @@ This module converts between that world and ``TileStorage``:
   (exactly numroc-sized, column-major), making it a portable checkpoint/
   interchange format: a real ScaLAPACK program could consume the output.
 
+Local arrays on import may be either exactly numroc-sized or allocated
+with LLD rows (the padded shape a single-descriptor ScaLAPACK program
+holds); at ragged sizes the two differ for processes owning the short
+block row, and both must round-trip.
+
+This layout IS the checkpoint interchange format: ``robust/checkpoint.py``
+serializes factorization state through the pure-numpy ``scatter_locals``/
+``gather_locals`` pair below, so a checkpoint payload is consumable by a
+real ScaLAPACK program (and vice versa) without any slate-specific
+decoder.
+
 Only RSRC = CSRC = 0 is supported (the reference's wrappers assert the
 same before wrapping, scalapack_api/scalapack_slate.hh).
 """
@@ -50,16 +61,23 @@ def numroc(n: int, nb: int, iproc: int, isrc: int, nprocs: int) -> int:
     return num
 
 
+def descinit_pq(m: int, n: int, mb: int, nb: int, p: int,
+                rsrc: int = 0, csrc: int = 0, ctxt: int = 0) -> tuple:
+    """Grid-free ``descinit``: builds the descriptor from the process-row
+    count alone (LLD only depends on ``p``).  Pure integers, no devices —
+    this is the entry the checkpoint layer uses."""
+    slate_error(rsrc == 0 and csrc == 0,
+                "descinit: only RSRC=CSRC=0 supported")
+    lld = max(1, max(numroc(m, mb, pr, rsrc, p) for pr in range(p)))
+    return (DTYPE_DENSE, ctxt, m, n, mb, nb, rsrc, csrc, lld)
+
+
 def descinit(m: int, n: int, mb: int, nb: int, grid: Grid,
              rsrc: int = 0, csrc: int = 0, ctxt: int = 0) -> tuple:
     """Build the 9-integer array descriptor (scalapack descinit.f).
     LLD is the max over the grid column's local row counts, as a
     single-descriptor program would allocate."""
-    slate_error(rsrc == 0 and csrc == 0,
-                "descinit: only RSRC=CSRC=0 supported")
-    lld = max(1, max(numroc(m, mb, pr, rsrc, grid.p)
-                     for pr in range(grid.p)))
-    return (DTYPE_DENSE, ctxt, m, n, mb, nb, rsrc, csrc, lld)
+    return descinit_pq(m, n, mb, nb, grid.p, rsrc, csrc, ctxt)
 
 
 def _check_desc(desc) -> tuple:
@@ -70,18 +88,18 @@ def _check_desc(desc) -> tuple:
     return m, n, mb, nb, lld
 
 
-def from_scalapack(desc, locals_, grid: Grid | None = None):
-    """Assemble per-process local arrays into a tiled Matrix.
+def gather_locals(desc, locals_, p: int, q: int) -> np.ndarray:
+    """Assemble per-process ScaLAPACK locals into one dense numpy array.
 
     ``locals_``: mapping {(pr, pc): 2D array} or nested list
-    ``locals_[pr][pc]`` of the exactly numroc-sized column-major local
-    pieces (Fortran or C memory order both accepted — shape is what
-    matters).  Returns a ``Matrix`` with tile sizes (MB, NB) on ``grid``.
+    ``locals_[pr][pc]``.  Each piece may be exactly numroc-sized
+    ``(ml, nl)`` or LLD-row-padded ``(lld, nl)`` with ``lld >= ml`` — the
+    shape a real single-descriptor program allocates; at ragged sizes the
+    short-block-row processes have ``ml < lld`` and only the leading
+    ``ml`` rows are meaningful.  Pure numpy (no devices): usable from the
+    checkpoint layer on a host with no accelerator attached.
     """
-    from ..core.matrix import Matrix
-    grid = grid or Grid(1, 1)
-    m, n, mb, nb, _ = _check_desc(desc)
-    p, q = grid.p, grid.q
+    m, n, mb, nb, lld = _check_desc(desc)
 
     def loc(pr, pc):
         piece = (locals_[(pr, pc)] if isinstance(locals_, dict)
@@ -94,9 +112,12 @@ def from_scalapack(desc, locals_, grid: Grid | None = None):
             piece = loc(pr, pc)
             ml = numroc(m, mb, pr, 0, p)
             nl = numroc(n, nb, pc, 0, q)
-            slate_error(piece.shape == (ml, nl),
-                        f"local ({pr},{pc}) shape {piece.shape} != "
-                        f"numroc ({ml},{nl})")
+            slate_error(
+                piece.shape == (ml, nl)
+                or (piece.shape[0] == lld >= ml and piece.shape[1] == nl),
+                f"local ({pr},{pc}) shape {piece.shape} != "
+                f"numroc ({ml},{nl}) nor LLD-padded ({lld},{nl})")
+            piece = piece[:ml]
             # local block row lb covers global rows of block ib = lb*p + pr
             for lb in range(-(-ml // mb) if mb else 0):
                 gi = (lb * p + pr) * mb
@@ -106,18 +127,18 @@ def from_scalapack(desc, locals_, grid: Grid | None = None):
                     w = min(nb, n - gj, nl - lc * nb)
                     dense[gi:gi + h, gj:gj + w] = \
                         piece[lb * mb:lb * mb + h, lc * nb:lc * nb + w]
-    return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
+    return dense
 
 
-def to_scalapack(A):
-    """Export a Matrix to (desc, {(pr, pc): local array}) in ScaLAPACK
-    layout on A's grid.  Local arrays are Fortran-ordered (column-major),
-    as a ScaLAPACK program would hold them."""
-    grid = A.grid
-    m, n, mb, nb = A.m, A.n, A.mb, A.nb
-    desc = descinit(m, n, mb, nb, grid)
-    dense = np.asarray(A.to_dense())
-    p, q = grid.p, grid.q
+def scatter_locals(dense: np.ndarray, mb: int, nb: int,
+                   p: int, q: int) -> tuple:
+    """Split a dense numpy array into (desc, {(pr, pc): local array}) in
+    ScaLAPACK 2D block-cyclic layout.  Local arrays are Fortran-ordered
+    and exactly numroc-sized.  Pure numpy; the checkpoint layer's
+    serialization path."""
+    dense = np.asarray(dense)
+    m, n = dense.shape
+    desc = descinit_pq(m, n, mb, nb, p)
     out = {}
     for pr in range(p):
         for pc in range(q):
@@ -134,3 +155,27 @@ def to_scalapack(A):
                         dense[gi:gi + h, gj:gj + w]
             out[(pr, pc)] = piece
     return desc, out
+
+
+def from_scalapack(desc, locals_, grid: Grid | None = None):
+    """Assemble per-process local arrays into a tiled Matrix.
+
+    ``locals_``: mapping {(pr, pc): 2D array} or nested list
+    ``locals_[pr][pc]`` of the column-major local pieces — exactly
+    numroc-sized or LLD-row-padded, Fortran or C memory order both
+    accepted (shape is what matters).  Returns a ``Matrix`` with tile
+    sizes (MB, NB) on ``grid``.
+    """
+    from ..core.matrix import Matrix
+    grid = grid or Grid(1, 1)
+    m, n, mb, nb, _ = _check_desc(desc)
+    dense = gather_locals(desc, locals_, grid.p, grid.q)
+    return Matrix(TileStorage.from_dense(dense, mb, nb, grid))
+
+
+def to_scalapack(A):
+    """Export a Matrix to (desc, {(pr, pc): local array}) in ScaLAPACK
+    layout on A's grid.  Local arrays are Fortran-ordered (column-major),
+    as a ScaLAPACK program would hold them."""
+    dense = np.asarray(A.to_dense())
+    return scatter_locals(dense, A.mb, A.nb, A.grid.p, A.grid.q)

@@ -207,6 +207,99 @@ def _finalize_potrf(L, h, uplo, opts):
             f"({hh.describe()})", info=int(hh.info)))
 
 
+def _ooc_chol_health(lfac_host) -> "_health.HealthInfo":
+    """Cholesky health from HOST reductions: the OOC factor must never be
+    re-materialized on device just to check it (it may not fit)."""
+    import numpy as np
+    d = np.abs(np.diagonal(lfac_host))
+    d = np.where(np.isnan(d), 0.0, d)
+    minidx = int(np.argmin(d)) if d.size else 0
+    minpiv = float(d[minidx]) if d.size else float("inf")
+    h = _health.healthy(lfac_host.dtype)
+    bad = (minpiv == 0.0) or not np.isfinite(minpiv)
+    return h._replace(
+        nonfinite=jnp.asarray(not bool(np.all(np.isfinite(lfac_host)))),
+        info=jnp.asarray(minidx + 1 if bad else 0, jnp.int32),
+        min_pivot=jnp.asarray(minpiv, h.min_pivot.dtype),
+        min_pivot_index=jnp.asarray(minidx, jnp.int32),
+    )
+
+
+@annotate("slate.potrf_ooc")
+def potrf_ooc(a, nb: int | None = None, opts: Options | None = None,
+              checkpoint=None, resume: bool = False):
+    """Out-of-core Cholesky of a HOST-resident SPD matrix (lower).
+
+    ``a`` is a dense host numpy array that need not fit device memory:
+    a :class:`~slate_tpu.core.storage.TileMap` streams block-column
+    panels through HBM, with the next left panel's H2D prefetch
+    overlapped against the current panel's update — the distributed
+    kernels' hide-communication discipline applied to the host-device
+    axis.  Only the lower triangle (and diagonal) of ``a`` is read.
+    Returns the lower-triangular factor as a host numpy array;
+    Option.ErrorPolicy resolves failures exactly like :func:`potrf`.
+
+    Durability (docs/ROBUSTNESS.md "Durable jobs"): with a ``checkpoint``
+    :class:`~slate_tpu.robust.checkpoint.CheckpointManager` the host tile
+    map is snapshotted at panel-step boundaries per the manager cadence;
+    ``resume=True`` verifies and continues from the latest snapshot —
+    bit-identical to the uninterrupted run — refusing with a typed
+    ``SlateCheckpointError`` on torn/stale/corrupt state.  The in-core
+    ABFT rungs do not ride this loop; the checkpoint's row/column
+    checksums guard the offloaded state instead.
+    """
+    import numpy as np
+    from ..core.storage import TileMap
+    from ..internal.potrf import ooc_chol_panel, ooc_chol_update
+    from ..robust.checkpoint import ensure_fingerprint, ooc_fingerprint
+    from ..tune import ooc_panel_width
+
+    if resume:
+        slate_error(checkpoint is not None,
+                    "potrf_ooc: resume=True needs a checkpoint manager")
+        ck = checkpoint.load(op="potrf_ooc")
+        n = ck.matrix.shape[0]
+        nb = int(ck.meta["nb"])
+        fp = ooc_fingerprint("potrf_ooc", n, n, nb, ck.meta["dtype"])
+        ensure_fingerprint(ck, fp)
+        tm = TileMap(ck.matrix, nb, nb)
+        k_start = int(ck.step)
+    else:
+        ad = np.asarray(a)
+        slate_error(ad.ndim == 2 and ad.shape[0] == ad.shape[1],
+                    "potrf_ooc: square 2D host matrix")
+        n = ad.shape[0]
+        nb = int(nb) if nb else ooc_panel_width(n, ad.dtype.name)
+        fp = ooc_fingerprint("potrf_ooc", n, n, nb, ad.dtype.name)
+        tm = TileMap(ad, nb, nb)
+        k_start = 0
+
+    steps = list(range(0, n, nb))
+    for si in range(k_start, len(steps)):
+        k0 = steps[si]
+        k1 = min(k0 + nb, n)
+        w = k1 - k0
+        if checkpoint is not None and checkpoint.should_save(si):
+            checkpoint.save("potrf_ooc", si, tm.host_array(), nb, nb, fp)
+        prev = steps[:si]
+        if prev:
+            tm.prefetch(k0, n, prev[0], prev[0] + nb)
+        acc = tm.fetch(k0, n, k0, k1)
+        for idx, j0 in enumerate(prev):
+            left = tm.fetch(k0, n, j0, j0 + nb)
+            if idx + 1 < len(prev):
+                tm.prefetch(k0, n, prev[idx + 1], prev[idx + 1] + nb)
+            # A[k0:k1, j0:j1] is the leading w rows of the left panel
+            acc = ooc_chol_update(acc, left, left[:w])
+        tm.store(k0, n, k0, k1, ooc_chol_panel(acc))
+    lfac = np.tril(tm.host_array())
+    return _health.finalize(
+        "potrf_ooc", lfac, _ooc_chol_health(lfac), opts,
+        lambda hh: SlateNotPositiveDefiniteError(
+            f"potrf_ooc: leading minor not positive definite "
+            f"({hh.describe()})", info=int(hh.info)))
+
+
 @annotate("slate.potrs")
 def potrs(L: TriangularMatrix, B, opts: Options | None = None) -> Matrix:
     """Solve with the Cholesky factor: two triangular sweeps

@@ -345,6 +345,117 @@ def _singular(name: str):
         f"({h.describe()})", info=int(h.info))
 
 
+class OocLUFactors(NamedTuple):
+    """Out-of-core LU result: L\\U packed in one HOST numpy array + global
+    row permutation (A[perm] = L U).  Host-resident because the whole
+    point of getrf_ooc is that the factor need not fit device memory."""
+    LU: "np.ndarray"  # noqa: F821 — host array, numpy imported lazily
+    perm: "np.ndarray"  # noqa: F821
+
+
+def _ooc_lu_health(lu_host, minpiv: float, minidx: int, amax: float):
+    """LU health from HOST reductions (the OOC factor stays off-device)."""
+    import numpy as np
+    h = _health.healthy(lu_host.dtype)
+    fmax = float(np.max(np.abs(lu_host))) if lu_host.size else 0.0
+    bad = (minpiv == 0.0) or not np.isfinite(minpiv)
+    growth = fmax / amax if amax > 0 else float("inf")
+    return h._replace(
+        nonfinite=jnp.asarray(not bool(np.all(np.isfinite(lu_host)))),
+        info=jnp.asarray(minidx + 1 if bad else 0, jnp.int32),
+        min_pivot=jnp.asarray(minpiv, h.min_pivot.dtype),
+        min_pivot_index=jnp.asarray(minidx, jnp.int32),
+        growth=jnp.asarray(growth, h.growth.dtype),
+    )
+
+
+@annotate("slate.getrf_ooc")
+def getrf_ooc(a, nb: int | None = None, opts: Options | None = None,
+              checkpoint=None, resume: bool = False):
+    """Out-of-core partially-pivoted LU of a HOST-resident matrix.
+
+    ``a`` is a dense host numpy array that need not fit device memory: a
+    :class:`~slate_tpu.core.storage.TileMap` streams the pivot panel and
+    one trailing block column at a time through HBM, prefetching the next
+    trailing column while the current one updates (PR 15's
+    hide-communication discipline on the host-device axis).  Returns
+    :class:`OocLUFactors`; Option.ErrorPolicy resolves failures exactly
+    like :func:`getrf`.
+
+    Durability (docs/ROBUSTNESS.md "Durable jobs"): with a ``checkpoint``
+    :class:`~slate_tpu.robust.checkpoint.CheckpointManager` the host tile
+    map plus the accumulated permutation are snapshotted at panel-step
+    boundaries; ``resume=True`` verifies the latest snapshot's ABFT
+    checksums before continuing and is bit-identical to the
+    uninterrupted run, refusing with a typed ``SlateCheckpointError``
+    on torn/stale/corrupt state.
+    """
+    import numpy as np
+    from ..core.storage import TileMap
+    from ..internal.getrf import ooc_lu_panel, ooc_lu_trailing
+    from ..robust.checkpoint import ensure_fingerprint, ooc_fingerprint
+    from ..tune import ooc_panel_width
+
+    if resume:
+        slate_error(checkpoint is not None,
+                    "getrf_ooc: resume=True needs a checkpoint manager")
+        ck = checkpoint.load(op="getrf_ooc")
+        m, n = ck.matrix.shape
+        nb = int(ck.meta["nb"])
+        fp = ooc_fingerprint("getrf_ooc", m, n, nb, ck.meta["dtype"])
+        ensure_fingerprint(ck, fp)
+        tm = TileMap(ck.matrix, nb, nb)
+        perm_g = ck.extras["perm"].astype(np.int64, copy=True)
+        amax = float(ck.extras["amax"][()])
+        k_start = int(ck.step)
+    else:
+        ad = np.asarray(a)
+        slate_error(ad.ndim == 2, "getrf_ooc: 2D host matrix")
+        m, n = ad.shape
+        nb = int(nb) if nb else ooc_panel_width(max(m, n), ad.dtype.name)
+        fp = ooc_fingerprint("getrf_ooc", m, n, nb, ad.dtype.name)
+        tm = TileMap(ad, nb, nb)
+        perm_g = np.arange(m, dtype=np.int64)
+        amax = float(np.max(np.abs(ad))) if ad.size else 0.0
+        k_start = 0
+
+    kmax = min(m, n)
+    steps = list(range(0, kmax, nb))
+    for si in range(k_start, len(steps)):
+        k0 = steps[si]
+        k1 = min(k0 + nb, kmax)
+        if checkpoint is not None and checkpoint.should_save(si):
+            checkpoint.save(
+                "getrf_ooc", si, tm.host_array(), nb, nb, fp,
+                extras={"perm": perm_g,
+                        "amax": np.asarray(amax, np.float64)})
+        panel = tm.fetch(k0, m, k0, k1)
+        lu, perm = ooc_lu_panel(panel)
+        perm_h = np.asarray(perm)
+        if k0:
+            tm.permute_rows(k0, 0, k0, perm_h)
+        perm_g[k0:] = perm_g[k0:][perm_h]
+        tm.store(k0, m, k0, k1, lu)
+        trail = list(range(k1, n, nb))
+        if trail:
+            tm.prefetch(k0, m, trail[0], min(trail[0] + nb, n))
+        for ti, j0 in enumerate(trail):
+            j1 = min(j0 + nb, n)
+            colj = tm.fetch(k0, m, j0, j1)
+            if ti + 1 < len(trail):
+                tm.prefetch(k0, m, trail[ti + 1],
+                            min(trail[ti + 1] + nb, n))
+            tm.store(k0, m, j0, j1, ooc_lu_trailing(colj, lu, perm))
+    lu_h = tm.host_array().copy()
+    udiag = np.abs(np.diagonal(lu_h[:kmax, :kmax]))
+    udiag = np.where(np.isnan(udiag), 0.0, udiag)
+    minidx = int(np.argmin(udiag)) if udiag.size else 0
+    minpiv = float(udiag[minidx]) if udiag.size else float("inf")
+    h = _ooc_lu_health(lu_h, minpiv, minidx, amax)
+    return _health.finalize("getrf_ooc", OocLUFactors(lu_h, perm_g), h,
+                            opts, _singular("getrf_ooc"))
+
+
 def _getrs_rbt(F: RBTFactors, B, opts: Options | None) -> Matrix:
     """getrs body for RBT factors: the RAW transformed solve
     x = V (A~^-1 (U^T [b; 0]))[:n] — no refinement, no certification

@@ -75,6 +75,26 @@ class SlateServeOverloadError(SlateServeError):
         self.policy = policy
 
 
+class SlateCheckpointError(SlateError):
+    """A checkpoint could not be trusted for resume.
+
+    Raised by ``robust/checkpoint.py`` when verification fails BEFORE any
+    work continues — a torn/truncated payload, a digest or ABFT checksum
+    mismatch, a manifest/payload skew (stale read), or a run whose
+    resolved options/plan fingerprint differs from the one that wrote the
+    snapshot.  The contract is refuse-loudly: a bad checkpoint must never
+    silently restart or silently resume into a wrong answer.
+
+    ``reason`` carries which rung refused (``missing`` / ``torn`` /
+    ``corrupt`` / ``stale`` / ``abft`` / ``fingerprint``); ``step`` is the
+    panel-step index the checkpoint claimed, -1 when unknown."""
+
+    def __init__(self, msg: str, reason: str = "corrupt", step: int = -1):
+        super().__init__(msg)
+        self.reason = reason
+        self.step = step
+
+
 def slate_error(cond: bool, msg: str = "error") -> None:
     """Raise SlateValueError unless ``cond`` (ref: Exception.hh slate_error)."""
     if not cond:

@@ -39,6 +39,31 @@ def panel_lu(panel):
     return lu, perm
 
 
+# ---- out-of-core step kernels (drivers/lu.py getrf_ooc) ----
+# Pure jitted functions of the device windows the TileMap streams in;
+# jit's shape-keyed cache reuses one executable per window shape across
+# steps and across a checkpoint resume, which is what makes a resumed
+# run bit-identical to the uninterrupted one.
+
+@jax.jit
+def ooc_lu_panel(panel):
+    """Partially-pivoted LU of the gathered current panel [W, nb]."""
+    return panel_lu(panel)
+
+
+@jax.jit
+def ooc_lu_trailing(colj, lu, perm):
+    """One streamed right-looking trailing update: apply the panel's row
+    permutation to trailing block column ``colj`` [W, wj], solve the U12
+    strip against unit-L11 and subtract the L21 @ U12 contribution.
+    Returns the updated [U12; trailing] column."""
+    w = lu.shape[1]
+    colj = colj[perm]
+    u12 = tri_inv_lower(lu[:w, :w], unit_diag=True) @ colj[:w]
+    tail = colj[w:] - lu[w:, :w] @ u12
+    return jnp.concatenate([u12, tail], axis=0)
+
+
 def _nopiv_fused_ok(dtype, w: int, nb: int) -> bool:
     """True when the tuned plan routes this no-pivot panel through the
     fused Pallas kernel (internal/pallas_lu.py lu_panel_fused): f32,

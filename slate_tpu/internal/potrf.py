@@ -67,6 +67,35 @@ def potrf_panel_ok(dtype, m: int, w: int, nb: int) -> bool:
     return resolve_plan("potrf_panel", m, "float32").kernel == "pallas"
 
 
+# ---- out-of-core panel-step kernels (drivers/cholesky.py potrf_ooc) ----
+# Each step of the OOC left-looking loop is a pure jitted function of the
+# device windows the TileMap streams in; jit's shape-keyed cache gives one
+# executable per (panel width, remaining height), reused across steps AND
+# across a checkpoint resume — a load-bearing property: bit-identical
+# resume relies on the resumed run dispatching the exact same kernels on
+# the exact same bytes as the uninterrupted one.
+
+@jax.jit
+def ooc_chol_update(acc, left, lead):
+    """One streamed left-looking accumulation: subtract the contribution
+    of a previous block column.  ``acc`` [m-k0, w] is the running panel,
+    ``left`` = A[k0:, j0:j1], ``lead`` = A[k0:k1, j0:j1]."""
+    return acc - left @ jnp.conj(lead).T
+
+
+@jax.jit
+def ooc_chol_panel(upd):
+    """Factor the fully-accumulated [m-k0, w] panel: returns [L00; L21]
+    with the diagonal tile routed through the tuned potrf_tile and the
+    rows below one MXU gemm against the inverted L00 (same seam as the
+    in-core blocked loop in drivers/cholesky.py)."""
+    from .trsm import tri_inv_lower
+    w = upd.shape[1]
+    lkk = potrf_tile(upd[:w])
+    tail = upd[w:] @ jnp.conj(tri_inv_lower(lkk)).T
+    return jnp.concatenate([lkk, tail], axis=0)
+
+
 def potrf_panel_fused(col, left, lead):
     """Fused left-looking panel step (see pallas_chol.chol_panel_fused):
     returns (upd, fac) = (pre-factor panel for the ABFT rungs,

@@ -34,12 +34,12 @@ DEFAULT_GATE_PCT = 10.0
 
 #: substrings marking a metric whose smaller values are better
 _LOWER_BETTER = ("waste", "overhead", "latency", "_ms", "compile",
-                 "retrace", "shed", "quar")
+                 "retrace", "shed", "quar", "slowdown")
 #: metric-name substrings with wider run-to-run noise (percent); first
 #: match wins, so survival (timing-sensitive shed/quarantine rates under
 #: a live flush loop) outranks the generic serve band
-_NOISY = (("survival", 20.0), ("serve", 15.0), ("sweep", 10.0),
-          ("batch", 10.0), ("lookahead", 10.0))
+_NOISY = (("survival", 20.0), ("durability", 20.0), ("serve", 15.0),
+          ("sweep", 10.0), ("batch", 10.0), ("lookahead", 10.0))
 
 
 def direction(metric: str, unit: str | None = None) -> str:

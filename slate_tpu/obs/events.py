@@ -353,6 +353,19 @@ def emit_serve_quarantine(payload: dict) -> None:
            **payload})
 
 
+def emit_checkpoint(kind: str, payload: dict) -> None:
+    """One record per checkpoint save or verified restore (kinds
+    ``checkpoint_save`` / ``checkpoint_restore``; robust/checkpoint.py
+    is the only caller).  The payload carries the op, the panel-step
+    index ``step``, payload ``bytes``, the ``verify`` result ("ok" or
+    the typed refusal reason — torn / stale / corrupt / abft /
+    fingerprint) and ``wall_ms`` — the inputs behind the metrics CLI's
+    durability table (docs/ROBUSTNESS.md "Durable jobs")."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": kind, "ts": time.time(), **payload})
+
+
 def _emit(event: dict) -> None:
     with _LOCK:
         _RING.append(event)

@@ -213,7 +213,7 @@ def _f_hemm(shapes, sizes):
     return 2.0 * m * m * _rhs(shapes)
 
 
-@register("potrf")
+@register("potrf", "potrf_ooc")
 def _f_potrf(shapes, sizes):
     n = _s(shapes, 0)[0]
     return n ** 3 / 3.0
@@ -237,7 +237,8 @@ def _f_potri(shapes, sizes):
     return n ** 3 / 3.0
 
 
-@register("getrf", "getrf_nopiv", "getrf_tntpiv", "getrf_rbt", "hetrf")
+@register("getrf", "getrf_nopiv", "getrf_tntpiv", "getrf_rbt", "hetrf",
+          "getrf_ooc")
 def _f_getrf(shapes, sizes):
     n = min(_s(shapes, 0)[:2]) if len(_s(shapes, 0)) >= 2 \
         else _s(shapes, 0)[0]

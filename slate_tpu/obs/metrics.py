@@ -97,8 +97,13 @@ def _parse_lines(text: str, out: list) -> tuple[int, int]:
 
 
 def split_records(records):
-    """(events, spans, serve, bench, unknown) from a mixed record list."""
-    events, spans, serve, bench, unknown = [], [], [], [], []
+    """(events, spans, serve, bench, ckpt, unknown) from a mixed record
+    list.  ``ckpt`` holds the durability layer's ``checkpoint_save`` /
+    ``checkpoint_restore`` records (robust/checkpoint.py via
+    obs.events.emit_checkpoint); it is appended AFTER bench so existing
+    positional consumers (compare.py takes [3], slo.py takes [2]) stay
+    valid."""
+    events, spans, serve, bench, ckpt, unknown = [], [], [], [], [], []
     for r in records:
         schema, kind = r.get("schema"), r.get("kind")
         if schema == EVENT_SCHEMA and kind == "event":
@@ -108,11 +113,14 @@ def split_records(records):
         elif schema == EVENT_SCHEMA and kind in (
                 "serve_batch", "serve_shed", "serve_quarantine"):
             serve.append(r)
+        elif schema == EVENT_SCHEMA and kind in (
+                "checkpoint_save", "checkpoint_restore"):
+            ckpt.append(r)
         elif schema == BENCH_SCHEMA or "metric" in r:
             bench.append(r)
         else:
             unknown.append(r)
-    return events, spans, serve, bench, unknown
+    return events, spans, serve, bench, ckpt, unknown
 
 
 def percentile(values, q: float) -> float | None:
@@ -283,18 +291,52 @@ def summarize_serve(serve) -> dict:
     return dict(sorted(table.items()))
 
 
+def summarize_checkpoint(ckpt) -> dict:
+    """Durability table: per (op, kind) checkpoint traffic — event count,
+    bytes moved, save/restore wall-clock percentiles and the verify
+    outcome tally (ok vs each typed refusal reason), so a glance shows
+    whether resumes are verifying cleanly and what snapshots cost."""
+    table: dict[str, dict] = {}
+    for e in ckpt:
+        key = f"{e.get('op') or '?'}/{e.get('kind') or '?'}"
+        s = table.setdefault(key, {
+            "count": 0, "bytes": 0, "ok": 0, "refused": 0,
+            "_wall": [], "_reasons": {}})
+        s["count"] += 1
+        if isinstance(e.get("bytes"), (int, float)):
+            s["bytes"] += int(e["bytes"])
+        if isinstance(e.get("wall_ms"), (int, float)):
+            s["_wall"].append(float(e["wall_ms"]))
+        verify = e.get("verify") or "?"
+        if verify == "ok":
+            s["ok"] += 1
+        else:
+            s["refused"] += 1
+            s["_reasons"][verify] = s["_reasons"].get(verify, 0) + 1
+    for s in table.values():
+        wall = s.pop("_wall")
+        reasons = s.pop("_reasons")
+        s["wall_p50_ms"] = percentile(wall, 50)
+        s["wall_p99_ms"] = percentile(wall, 99)
+        s["refusals"] = ",".join(f"{k}={v}" for k, v in
+                                 sorted(reasons.items())) or None
+    return dict(sorted(table.items()))
+
+
 def summarize(paths) -> dict:
     """Everything the CLI prints, as one JSON-able dict."""
     records, malformed = load_records(paths)
-    events, spans, serve, bench, unknown = split_records(records)
+    events, spans, serve, bench, ckpt, unknown = split_records(records)
     return {
         "files": [str(p) for p in paths],
         "counts": {"events": len(events), "spans": len(spans),
                    "serve": len(serve), "bench": len(bench),
+                   "checkpoint": len(ckpt),
                    "unknown": len(unknown), "malformed": malformed},
         "ops": summarize_events(events),
         "plans": summarize_plans(events),
         "serve": summarize_serve(serve),
+        "checkpoint": summarize_checkpoint(ckpt),
         "bench": summarize_bench(bench),
     }
 
@@ -327,6 +369,8 @@ def render(summary: dict) -> str:
     parts.append(f"records: {c['events']} events, {c['spans']} spans, "
                  f"{c.get('serve', 0)} serve batches, "
                  f"{c['bench']} bench lines"
+                 + (f", {c['checkpoint']} checkpoint"
+                    if c.get("checkpoint") else "")
                  + (f", {c['unknown']} unknown" if c["unknown"] else ""))
     if summary["ops"]:
         rows = [[op, s["count"], s["traced"], s["p50_ms"], s["p99_ms"],
@@ -355,6 +399,14 @@ def render(summary: dict) -> str:
              "waste_p50", "lat_p50_ms", "lat_p99_ms", "mfu", "wa_pps",
              "esc/1k", "shed/1k", "quar/1k", "retraces", "compiles"],
             rows))
+    if summary.get("checkpoint"):
+        rows = [[key, s["count"], s["bytes"], s["wall_p50_ms"],
+                 s["wall_p99_ms"], s["ok"], s["refused"],
+                 s.get("refusals")]
+                for key, s in summary["checkpoint"].items()]
+        parts.append("\ndurability\n" + _table(
+            ["op/kind", "count", "bytes", "wall_p50_ms", "wall_p99_ms",
+             "ok", "refused", "refusals"], rows))
     bench = summary["bench"]
     if bench["metrics"]:
         rows = [[m, d.get("value"), d.get("unit"), d.get("mfu"),

@@ -1,7 +1,8 @@
 """Numerical-health subsystem: jit-safe info codes, fault injection,
-a-posteriori certification, and driver-level recovery/escalation.
+a-posteriori certification, driver-level recovery/escalation, and
+durable panel-boundary checkpoints.
 
-Four parts (see docs/ROBUSTNESS.md for the per-driver contract table):
+Five parts (see docs/ROBUSTNESS.md for the per-driver contract table):
 
 - :mod:`health`   — the ``HealthInfo`` pytree threaded through the factor
   and solve drivers, plus the ``Option.ErrorPolicy`` resolution that
@@ -18,6 +19,10 @@ Four parts (see docs/ROBUSTNESS.md for the per-driver contract table):
   non-HPD input, certification-gated spectral method escalation
   (heev Auto -> DC -> QR, svd Auto -> Bidiag, hesv -> gesv), and the
   bounded-retry policy the mixed-precision fallback routes through.
+- :mod:`checkpoint` — durable panel-boundary snapshots for the
+  out-of-core drivers, with atomic write-then-rename and an ABFT /
+  digest / fingerprint verification ladder that refuses untrustworthy
+  state with a typed ``SlateCheckpointError`` before resuming.
 """
 
 from .health import (  # noqa: F401
@@ -31,4 +36,7 @@ from .faults import FaultPlan, inject, maybe_corrupt  # noqa: F401
 from .recovery import (  # noqa: F401
     bounded_retry, gesv_with_recovery, heev_with_recovery,
     hesv_with_recovery, posv_with_recovery, svd_with_recovery,
+)
+from .checkpoint import (  # noqa: F401
+    Checkpoint, CheckpointManager, SimulatedPreemption,
 )

@@ -86,6 +86,25 @@ SITES = ("input", "post_panel", "post_collective", "solve",
 #:                          recompile path under load)
 SERVE_SITES = ("serve_flush_delay", "serve_compile_stall",
                "serve_cache_evict")
+#: HOST-side durability chaos sites (docs/ROBUSTNESS.md "Durable jobs"):
+#: consumed via :func:`host_fire` by robust/checkpoint.py and the
+#: out-of-core tile map in core/storage.py —
+#:
+#: ``ckpt_torn_write``   the checkpoint payload write is truncated
+#:                       mid-file after the manifest digest was computed
+#:                       (a crash/preemption landing between write and
+#:                       fsync): resume must refuse with reason "torn"
+#: ``ckpt_stale_read``   the manifest writer re-reads a stale payload —
+#:                       the payload write is skipped but the manifest is
+#:                       republished against the old bytes: resume must
+#:                       refuse with reason "stale"
+#: ``ooc_copy_stall``    the tile map sleeps ``delay_s`` around a
+#:                       host<->device panel copy (a congested PCIe/DMA
+#:                       path): out-of-core results must stay correct,
+#:                       merely late
+CKPT_SITES = ("ckpt_torn_write", "ckpt_stale_read", "ooc_copy_stall")
+#: every host-side site host_fire will serve
+HOST_SITES = SERVE_SITES + CKPT_SITES
 KINDS = ("nan", "inf", "bitflip")
 
 # flipping exponent bit 6 of an O(1) value: finite, wildly wrong
@@ -112,9 +131,9 @@ class FaultPlan:
     delay_s: float = 0.0
 
     def __post_init__(self):
-        if self.site not in SITES and self.site not in SERVE_SITES:
+        if self.site not in SITES and self.site not in HOST_SITES:
             raise ValueError(f"unknown fault site {self.site!r}; "
-                             f"sites: {SITES + SERVE_SITES}")
+                             f"sites: {SITES + HOST_SITES}")
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"kinds: {KINDS}")
@@ -162,14 +181,15 @@ def active(site: str) -> FaultPlan | None:
 
 
 def host_fire(site: str) -> FaultPlan | None:
-    """Consume an active HOST-side serving chaos plan at ``site``.
+    """Consume an active HOST-side chaos plan at ``site``.
 
-    Unlike :func:`maybe_corrupt` this never touches a trace: the
-    serving layer calls it from plain host code (the flush loop, the
-    executable cache) and acts on the returned plan (sleep, evict).
-    Transient plans fire at most once per :func:`inject` activation —
-    one stalled compile, not a permanently broken cache."""
-    if site not in SERVE_SITES:
+    Unlike :func:`maybe_corrupt` this never touches a trace: the serving
+    and durability layers call it from plain host code (the flush loop,
+    the executable cache, the checkpoint writer, the tile-map copy path)
+    and act on the returned plan (sleep, evict, tear a write).  Transient
+    plans fire at most once per :func:`inject` activation — one stalled
+    compile or one torn checkpoint, not a permanently broken disk."""
+    if site not in HOST_SITES:
         return None
     plan = _ACTIVE.get(site)
     if plan is None:

@@ -3,16 +3,21 @@
 resolve them statically at trace time (plans.resolve_plan — the only
 entry point dispatch seams may use; see docs/TUNING.md).  The serving
 layer's bucket ladder rides the same cache under ``SERVE_BUCKET_OP``,
-read back through :func:`plans.serve_buckets` (docs/SERVING.md)."""
+read back through :func:`plans.serve_buckets` (docs/SERVING.md); the
+out-of-core drivers' streaming panel width rides it under
+``OOC_PANEL_OP``, read back through :func:`plans.ooc_panel_width`
+(docs/ROBUSTNESS.md "Durable jobs")."""
 
-from .plans import (ALL_OPS, DIST_LOOKAHEAD_OP, OPS, SCHEMA_VERSION,
-                    SERVE_BUCKET_OP, TilePlan, XLA_PLAN, cache_path,
-                    chip_kind, load_cache, lookahead_depth, plan_override,
-                    record_plan, reload, resolve_plan, save_cache,
-                    serve_buckets, validate_cache)
+from .plans import (ALL_OPS, DIST_LOOKAHEAD_OP, OOC_PANEL_OP, OPS,
+                    SCHEMA_VERSION, SERVE_BUCKET_OP, TilePlan, XLA_PLAN,
+                    cache_path, chip_kind, load_cache, lookahead_depth,
+                    ooc_panel_width, plan_override, record_plan, reload,
+                    resolve_plan, save_cache, serve_buckets,
+                    validate_cache)
 
-__all__ = ["ALL_OPS", "DIST_LOOKAHEAD_OP", "OPS", "SCHEMA_VERSION",
-           "SERVE_BUCKET_OP", "TilePlan", "XLA_PLAN", "cache_path",
-           "chip_kind", "load_cache", "lookahead_depth", "plan_override",
-           "record_plan", "reload", "resolve_plan", "save_cache",
-           "serve_buckets", "validate_cache"]
+__all__ = ["ALL_OPS", "DIST_LOOKAHEAD_OP", "OOC_PANEL_OP", "OPS",
+           "SCHEMA_VERSION", "SERVE_BUCKET_OP", "TilePlan", "XLA_PLAN",
+           "cache_path", "chip_kind", "load_cache", "lookahead_depth",
+           "ooc_panel_width", "plan_override", "record_plan", "reload",
+           "resolve_plan", "save_cache", "serve_buckets",
+           "validate_cache"]

@@ -56,7 +56,16 @@ DIST_LOOKAHEAD_OP = "dist_lookahead"
 # kernel-tuning op (no candidate sweep): each recorded entry's ``n`` is one
 # ladder rung for this chip (see serve_buckets / docs/SERVING.md).
 SERVE_BUCKET_OP = "serve_bucket"
-ALL_OPS = OPS + (DIST_LOOKAHEAD_OP, SERVE_BUCKET_OP)
+# Out-of-core panel width is another pseudo-op: not a kernel choice but
+# the host<->device streaming granularity of the TileMap drivers
+# (drivers/cholesky.py potrf_ooc, drivers/lu.py getrf_ooc) — wide enough
+# to amortize the H2D/D2H copies, narrow enough that two panels plus one
+# trailing window fit HBM.  Resolved only via ooc_panel_width(); like
+# the other pseudo-ops it is schema-accepted but excluded from OPS so
+# kernel candidate sweeps never measure it (OOC wins are end-to-end,
+# bench_potrf_ooc).
+OOC_PANEL_OP = "ooc_panel"
+ALL_OPS = OPS + (DIST_LOOKAHEAD_OP, SERVE_BUCKET_OP, OOC_PANEL_OP)
 KERNELS = ("xla", "pallas", "ring")
 
 
@@ -286,9 +295,10 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
     noted into the open obs event frame (cache hit vs nearest-n
     distance), so production events audit plan usage."""
     from ..obs import events as _obs
-    if op not in OPS and op != DIST_LOOKAHEAD_OP:
+    if op not in OPS and op not in (DIST_LOOKAHEAD_OP, OOC_PANEL_OP):
         raise ValueError(
-            f"unknown op {op!r} (known: {OPS + (DIST_LOOKAHEAD_OP,)})")
+            f"unknown op {op!r} "
+            f"(known: {OPS + (DIST_LOOKAHEAD_OP, OOC_PANEL_OP)})")
     _warn_removed_env()
     ov = _OVERRIDES.get(op)
     if ov is not None:
@@ -318,6 +328,23 @@ def lookahead_depth(n: int, dtype: str = "float32") -> int:
     if plan.kernel != "ring":
         return 0
     return max(1, min(2, int(plan.bw)))
+
+
+def ooc_panel_width(n: int, dtype: str = "float32",
+                    default: int = 256) -> int:
+    """Tuned out-of-core panel width for the TileMap streaming drivers.
+
+    The SINGLE accessor potrf_ooc/getrf_ooc consult when the caller does
+    not pin ``nb`` (SEAM011 — rides resolve_plan like lookahead_depth):
+    host-static arguments, static int result.  Untuned chips resolve to
+    the default XLA_PLAN and get ``default`` (clamped to n); a tuned
+    ``ooc_panel`` entry contributes its measured ``nb``.  The width also
+    feeds the resumed-run fingerprint (robust/checkpoint.py), so a tuned
+    width change between save and resume refuses rather than silently
+    changing the panel schedule."""
+    plan = resolve_plan(OOC_PANEL_OP, n, dtype)
+    width = plan.nb if plan is not XLA_PLAN else default
+    return max(1, min(int(width), int(n)))
 
 
 def serve_buckets(dtype: str = "float32") -> tuple[int, ...] | None:

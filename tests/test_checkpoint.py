@@ -1,0 +1,378 @@
+"""Durable-factorization tests: out-of-core drivers, panel-boundary
+checkpoints, ABFT-verified resume, and the durability chaos sites.
+
+The contract (docs/ROBUSTNESS.md "Durable jobs"):
+
+- ``potrf_ooc`` / ``getrf_ooc`` match their in-core drivers numerically
+  and keep the host TileMap authoritative;
+- a run killed right after ANY panel-step checkpoint resumes
+  BIT-IDENTICAL to the uninterrupted run, both dtypes;
+- every torn-write / stale-read / corrupted snapshot is refused with a
+  typed ``SlateCheckpointError`` naming the failed rung — never a silent
+  restart or a silent wrong answer;
+- checkpoint traffic is observable: ``checkpoint_save`` /
+  ``checkpoint_restore`` events with step, bytes, verify result and wall
+  ms, aggregated by the metrics CLI into the durability table.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import obs
+from slate_tpu.exceptions import SlateCheckpointError
+from slate_tpu.robust import (CheckpointManager, SimulatedPreemption,
+                              faults)
+from slate_tpu.robust.checkpoint import MANIFEST_NAME, PAYLOAD_NAME
+
+N, NB = 24, 8
+NSTEPS = -(-N // NB)
+
+
+def _spd(rng, n=N, dtype=np.float64):
+    a = rng.standard_normal((n, n)).astype(dtype)
+    return a @ a.T + n * np.eye(n, dtype=dtype)
+
+
+def _gen(rng, n=N, dtype=np.float64):
+    return rng.standard_normal((n, n)).astype(dtype)
+
+
+# ------------------------------------------------- out-of-core drivers
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_potrf_ooc_matches_incore(rng, dtype):
+    spd = _spd(rng, dtype=dtype)
+    L = st.potrf(st.SymmetricMatrix(
+        st.TileStorage.from_dense(spd, NB, NB), uplo=st.Uplo.Lower))
+    Lo = st.potrf_ooc(spd, nb=NB)
+    assert isinstance(Lo, np.ndarray) and Lo.dtype == dtype
+    tol = 1e-4 if dtype == np.float32 else 1e-10
+    np.testing.assert_allclose(np.tril(np.asarray(L.to_dense())), Lo,
+                               atol=tol)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_getrf_ooc_factors_correctly(rng, dtype):
+    a = _gen(rng, dtype=dtype)
+    F = st.getrf_ooc(a, nb=NB)
+    assert isinstance(F, st.OocLUFactors)
+    L = np.tril(F.LU, -1) + np.eye(N, dtype=dtype)
+    U = np.triu(F.LU)
+    tol = 1e-4 if dtype == np.float32 else 1e-10
+    np.testing.assert_allclose(a[F.perm], L @ U, atol=tol)
+
+
+def test_getrf_ooc_rectangular_and_ragged(rng):
+    a = rng.standard_normal((24, 16))
+    F = st.getrf_ooc(a, nb=7)                    # ragged panel width
+    kmax = 16
+    L = np.tril(F.LU[:, :kmax], -1) + np.eye(24, kmax)
+    U = np.triu(F.LU[:kmax])
+    np.testing.assert_allclose(a[F.perm], L @ U, atol=1e-10)
+
+
+def test_ooc_error_policy_info_and_raise(rng):
+    from slate_tpu import ErrorPolicy, Option
+    spd = _spd(rng)
+    r, h = st.potrf_ooc(spd, nb=NB,
+                        opts={Option.ErrorPolicy: ErrorPolicy.Info})
+    assert bool(h.ok)
+    with pytest.raises(st.SlateNotPositiveDefiniteError):
+        st.potrf_ooc(-spd, nb=NB)
+    with pytest.raises(st.SlateSingularError):
+        st.getrf_ooc(np.zeros((N, N)), nb=NB)
+
+
+def test_ooc_copy_stall_is_correct_merely_late(rng):
+    """The ooc_copy_stall chaos site stalls host<->device panel copies;
+    the result must be unchanged (the TileMap drains pending writebacks
+    before any dependent read)."""
+    a = _gen(rng)
+    base = st.getrf_ooc(a, nb=NB)
+    with faults.inject(faults.FaultPlan(site="ooc_copy_stall",
+                                        delay_s=0.005)):
+        stalled = st.getrf_ooc(a, nb=NB)
+    assert np.array_equal(base.LU, stalled.LU)
+    assert np.array_equal(base.perm, stalled.perm)
+
+
+def test_tilemap_residency_and_roundtrip(rng):
+    from slate_tpu.core.storage import TileMap
+    a = rng.standard_normal((N, N))
+    tm = TileMap(a, NB, NB)
+    assert tm.residency(0, 0) == "host"
+    dev = tm.fetch(0, N, 0, NB)
+    assert tm.residency(0, 0) == "device"
+    tm.store(0, N, 0, NB, np.asarray(dev) * 2.0)
+    assert tm.residency(0, 0) == "dirty"
+    tm.drain()
+    assert tm.residency(0, 0) == "host"
+    expect = a.copy()
+    expect[:, :NB] *= 2.0
+    np.testing.assert_array_equal(tm.to_dense(), expect)
+
+
+# ----------------------------------------- kill-at-every-step resume
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_potrf_ooc_resume_bit_identical_every_step(rng, tmp_path, dtype):
+    spd = _spd(rng, dtype=dtype)
+    base = st.potrf_ooc(spd, nb=NB)
+    for kill in range(NSTEPS):
+        d = tmp_path / f"k{kill}"
+        cm = CheckpointManager(d, every=1, abort_after_step=kill)
+        with pytest.raises(SimulatedPreemption):
+            st.potrf_ooc(spd, nb=NB, checkpoint=cm)
+        res = st.potrf_ooc(None, checkpoint=CheckpointManager(d),
+                           resume=True)
+        assert np.array_equal(res, base), f"step {kill} not bit-identical"
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_getrf_ooc_resume_bit_identical_every_step(rng, tmp_path, dtype):
+    a = _gen(rng, dtype=dtype)
+    base = st.getrf_ooc(a, nb=NB)
+    for kill in range(NSTEPS):
+        d = tmp_path / f"k{kill}"
+        cm = CheckpointManager(d, every=1, abort_after_step=kill)
+        with pytest.raises(SimulatedPreemption):
+            st.getrf_ooc(a, nb=NB, checkpoint=cm)
+        res = st.getrf_ooc(None, checkpoint=CheckpointManager(d),
+                           resume=True)
+        assert np.array_equal(res.LU, base.LU), f"step {kill}"
+        assert np.array_equal(res.perm, base.perm), f"step {kill}"
+
+
+def test_checkpointing_on_vs_off_bit_identical(rng, tmp_path):
+    """Snapshotting must never perturb the numerics: every-step
+    checkpointing produces the exact bytes of the checkpoint-free run."""
+    spd, a = _spd(rng), _gen(rng)
+    on = st.potrf_ooc(spd, nb=NB,
+                      checkpoint=CheckpointManager(tmp_path / "p", every=1))
+    assert np.array_equal(on, st.potrf_ooc(spd, nb=NB))
+    Fon = st.getrf_ooc(a, nb=NB,
+                       checkpoint=CheckpointManager(tmp_path / "g",
+                                                    every=2))
+    Foff = st.getrf_ooc(a, nb=NB)
+    assert np.array_equal(Fon.LU, Foff.LU)
+    assert np.array_equal(Fon.perm, Foff.perm)
+
+
+def test_resume_without_checkpoint_refuses_missing(tmp_path):
+    cm = CheckpointManager(tmp_path)
+    assert not cm.has_checkpoint()
+    with pytest.raises(SlateCheckpointError) as ei:
+        st.potrf_ooc(None, checkpoint=cm, resume=True)
+    assert ei.value.reason == "missing"
+
+
+# ------------------------------------------------- refusal ladder
+
+
+def _saved_manager(rng, tmp_path, kill=1):
+    """A directory holding the step-``kill`` snapshot of a getrf_ooc run."""
+    a = _gen(rng)
+    cm = CheckpointManager(tmp_path, every=1, abort_after_step=kill)
+    with pytest.raises(SimulatedPreemption):
+        st.getrf_ooc(a, nb=NB, checkpoint=cm)
+    return a
+
+
+def test_torn_write_refused(rng, tmp_path):
+    """ckpt_torn_write truncates the payload while the manifest digest
+    describes the full bytes — the size rung must refuse, typed."""
+    a = _gen(rng)
+    cm = CheckpointManager(tmp_path, every=1, abort_after_step=0)
+    with faults.inject(faults.FaultPlan(site="ckpt_torn_write")):
+        with pytest.raises(SimulatedPreemption):
+            st.getrf_ooc(a, nb=NB, checkpoint=cm)
+    with pytest.raises(SlateCheckpointError) as ei:
+        st.getrf_ooc(None, checkpoint=CheckpointManager(tmp_path),
+                     resume=True)
+    assert ei.value.reason == "torn"
+
+
+def test_stale_read_refused(rng, tmp_path):
+    """ckpt_stale_read republishes the manifest against the PREVIOUS
+    payload bytes: the digest rung passes (the manifest describes what is
+    on disk) but the step/seq skew rung refuses as stale."""
+    from slate_tpu.robust.checkpoint import ooc_fingerprint
+    a = _gen(rng)
+    cm = CheckpointManager(tmp_path, every=1)
+    fp = ooc_fingerprint("getrf_ooc", N, N, NB, "float64")
+    cm.save("getrf_ooc", 0, a, NB, NB, fp)
+    with faults.inject(faults.FaultPlan(site="ckpt_stale_read")):
+        cm.save("getrf_ooc", 1, a, NB, NB, fp)   # manifest says step 1,
+    with pytest.raises(SlateCheckpointError) as ei:  # payload is step 0
+        st.getrf_ooc(None, checkpoint=CheckpointManager(tmp_path),
+                     resume=True)
+    assert ei.value.reason == "stale"
+
+
+def test_truncated_payload_refused_torn(rng, tmp_path):
+    """A crash that truncates the payload after the manifest committed
+    (disk-level tear, no chaos site) fails the size rung."""
+    _saved_manager(rng, tmp_path)
+    p = tmp_path / PAYLOAD_NAME
+    blob = p.read_bytes()
+    p.write_bytes(blob[: len(blob) // 3])
+    with pytest.raises(SlateCheckpointError) as ei:
+        CheckpointManager(tmp_path).load()
+    assert ei.value.reason == "torn"
+
+
+def test_flipped_byte_refused_corrupt(rng, tmp_path):
+    """Bit rot in the payload with an intact manifest fails the SHA-256
+    rung before any state is deserialized."""
+    _saved_manager(rng, tmp_path)
+    p = tmp_path / PAYLOAD_NAME
+    blob = bytearray(p.read_bytes())
+    blob[-1] ^= 0xFF
+    p.write_bytes(bytes(blob))
+    with pytest.raises(SlateCheckpointError) as ei:
+        CheckpointManager(tmp_path).load()
+    assert ei.value.reason == "corrupt"
+
+
+def test_garbled_manifest_refused_corrupt(rng, tmp_path):
+    _saved_manager(rng, tmp_path)
+    (tmp_path / MANIFEST_NAME).write_text("{not json")
+    with pytest.raises(SlateCheckpointError) as ei:
+        CheckpointManager(tmp_path).load()
+    assert ei.value.reason == "corrupt"
+
+
+def test_abft_mismatch_refused(rng, tmp_path):
+    """A payload whose digest was re-stamped to hide a flipped matrix
+    byte still fails the ABFT rung: the matrix no longer reproduces its
+    stored row/column checksums.  This is the rung that catches silent
+    host-RAM corruption of the offloaded state."""
+    import hashlib
+    _saved_manager(rng, tmp_path)
+    p = tmp_path / PAYLOAD_NAME
+    blob = bytearray(p.read_bytes())
+    hlen = int.from_bytes(blob[8:16], "little")
+    blob[16 + hlen] ^= 0x01                 # first byte of local_0_0
+    p.write_bytes(bytes(blob))
+    mpath = tmp_path / MANIFEST_NAME
+    manifest = json.loads(mpath.read_text())
+    manifest["sha256"] = hashlib.sha256(bytes(blob)).hexdigest()
+    mpath.write_text(json.dumps(manifest))
+    with pytest.raises(SlateCheckpointError) as ei:
+        CheckpointManager(tmp_path).load()
+    assert ei.value.reason == "abft"
+
+
+def test_wrong_op_refused_fingerprint(rng, tmp_path):
+    _saved_manager(rng, tmp_path)           # holds a getrf_ooc snapshot
+    with pytest.raises(SlateCheckpointError) as ei:
+        st.potrf_ooc(None, checkpoint=CheckpointManager(tmp_path),
+                     resume=True)
+    assert ei.value.reason == "fingerprint"
+
+
+def test_changed_plan_refused_fingerprint(rng, tmp_path):
+    """A resuming run whose tuned plan resolution differs from the
+    writing run's (here: a forced plan override, in production a retuned
+    cache) cannot be bit-identical, so the fingerprint rung refuses."""
+    from slate_tpu.tune import TilePlan, plan_override
+    _saved_manager(rng, tmp_path)
+    with plan_override("getrf_panel",
+                       TilePlan(kernel="pallas", nb=NB, bw=16)):
+        with pytest.raises(SlateCheckpointError) as ei:
+            st.getrf_ooc(None, checkpoint=CheckpointManager(tmp_path),
+                         resume=True)
+    assert ei.value.reason == "fingerprint"
+
+
+def test_ensure_fingerprint_direct():
+    from slate_tpu.robust.checkpoint import (Checkpoint,
+                                             ensure_fingerprint)
+    ck = Checkpoint("op", 0, np.zeros((2, 2)), {},
+                    {"fingerprint": {"a": 1}})
+    ensure_fingerprint(ck, {"a": 1})        # match: no raise
+    with pytest.raises(SlateCheckpointError) as ei:
+        ensure_fingerprint(ck, {"a": 2})
+    assert ei.value.reason == "fingerprint"
+    assert ei.value.step == 0
+
+
+def test_checkpoint_cadence(tmp_path):
+    cm = CheckpointManager(tmp_path, every=3)
+    assert [s for s in range(7) if cm.should_save(s)] == [0, 3, 6]
+
+
+# ------------------------------------------------- observability
+
+
+def test_checkpoint_events_and_metrics_cli(rng, tmp_path, capsys):
+    """Save and restore each emit one event (op, step, bytes, verify,
+    wall_ms); the metrics pipeline routes them into the durability table
+    and the CLI renders it."""
+    a = _gen(rng)
+    d = tmp_path / "ck"
+    with obs.recording() as recs:
+        cm = CheckpointManager(d, every=1, abort_after_step=2)
+        with pytest.raises(SimulatedPreemption):
+            st.getrf_ooc(a, nb=NB, checkpoint=cm)
+        st.getrf_ooc(None, checkpoint=CheckpointManager(d), resume=True)
+    evs = [e for e in recs if e.get("kind") in ("checkpoint_save",
+                                                "checkpoint_restore")]
+    saves = [e for e in evs if e["kind"] == "checkpoint_save"]
+    restores = [e for e in evs if e["kind"] == "checkpoint_restore"]
+    # the resumed run re-snapshots step 2 before finishing it
+    assert [e["step"] for e in saves] == [0, 1, 2, 2]
+    assert len(restores) == 1 and restores[0]["verify"] == "ok"
+    for e in evs:
+        assert e["op"] == "getrf_ooc"
+        assert e["bytes"] > 0 and e["wall_ms"] >= 0
+
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in recs))
+    summary = obs.summarize([str(path)])
+    assert summary["counts"]["checkpoint"] == len(evs)
+    row = summary["checkpoint"]["getrf_ooc/checkpoint_save"]
+    assert row["count"] == 4 and row["ok"] == 4 and row["refused"] == 0
+    assert row["bytes"] > 0 and row["wall_p50_ms"] is not None
+    from slate_tpu.obs.__main__ import main as obs_main
+    assert obs_main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "durability" in out
+    assert "getrf_ooc/checkpoint_save" in out
+    assert "getrf_ooc/checkpoint_restore" in out
+
+
+def test_refusal_emits_typed_restore_event(rng, tmp_path):
+    """A refused resume is observable too: the checkpoint_restore event
+    carries the failed rung as its verify value."""
+    _saved_manager(rng, tmp_path)
+    p = tmp_path / PAYLOAD_NAME
+    p.write_bytes(p.read_bytes()[:10])
+    with obs.recording() as recs:
+        with pytest.raises(SlateCheckpointError):
+            CheckpointManager(tmp_path).load(op="getrf_ooc")
+    (ev,) = [e for e in recs if e.get("kind") == "checkpoint_restore"]
+    assert ev["verify"] == "torn"
+
+
+def test_scalapack_layout_is_the_payload_format(rng, tmp_path):
+    """The pinned interchange format: the snapshot's matrix bytes are the
+    compat/scalapack scatter of the host state — a ScaLAPACK program
+    could consume the payload without a slate-specific decoder."""
+    from slate_tpu.compat.scalapack import scatter_locals
+    from slate_tpu.robust.checkpoint import ooc_fingerprint
+    a = _gen(rng)
+    cm = CheckpointManager(tmp_path)
+    fp = ooc_fingerprint("getrf_ooc", N, N, NB, "float64")
+    cm.save("getrf_ooc", 0, a, NB, NB, fp)
+    ck = cm.load(op="getrf_ooc")
+    assert ck.step == 0
+    np.testing.assert_array_equal(ck.matrix, a)
+    desc, locals_ = scatter_locals(a, NB, NB, 1, 1)
+    assert tuple(ck.meta["desc"]) == desc
+    assert list(ck.meta["desc"])[4:6] == [NB, NB]

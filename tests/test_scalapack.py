@@ -57,6 +57,71 @@ def test_round_trip(rng, m, n, mb, nb):
     np.testing.assert_array_equal(B.to_numpy(), a)
 
 
+@pytest.mark.parametrize("m,n,mb,nb", [(17, 13, 5, 3), (9, 9, 4, 4),
+                                       (11, 7, 4, 2)])
+def test_round_trip_lld_padded_ragged(rng, m, n, mb, nb):
+    """A real single-descriptor ScaLAPACK program allocates every local
+    with LLD rows; at ragged sizes the short-block-row processes have
+    ml < LLD.  Import must accept those padded shapes and ignore the pad
+    rows (the regression: exact-numroc-only shape checks rejected them)."""
+    g = st.Grid(2, 2, devices=jax.devices()[:4])
+    a = rng.standard_normal((m, n))
+    desc, locals_ = to_scalapack(st.Matrix.from_numpy(a, mb, nb, g))
+    lld = desc[8]
+    assert any(piece.shape[0] < lld for piece in locals_.values()), \
+        "case must actually exercise ml < LLD"
+    padded = {}
+    for (pr, pc), piece in locals_.items():
+        buf = np.full((lld, piece.shape[1]), np.nan, piece.dtype, order="F")
+        buf[:piece.shape[0]] = piece
+        padded[(pr, pc)] = buf
+    B = from_scalapack(desc, padded, g)
+    np.testing.assert_array_equal(B.to_numpy(), a)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_round_trip_preserves_dtype(rng, dtype):
+    """The interchange format must not silently promote/demote: the
+    checkpoint layer round-trips BOTH compute dtypes bit-identically."""
+    from slate_tpu.compat.scalapack import gather_locals, scatter_locals
+    a = rng.standard_normal((17, 13)).astype(dtype)
+    desc, locals_ = scatter_locals(a, 5, 3, 2, 2)
+    for piece in locals_.values():
+        assert piece.dtype == dtype
+    back = gather_locals(desc, locals_, 2, 2)
+    assert back.dtype == dtype
+    np.testing.assert_array_equal(back, a)
+
+
+def test_gather_accepts_both_memory_orders(rng):
+    """Shape, not stride, defines a local piece: C-ordered copies of the
+    Fortran-ordered export gather to the same dense matrix."""
+    from slate_tpu.compat.scalapack import gather_locals, scatter_locals
+    a = rng.standard_normal((17, 13))
+    desc, locals_ = scatter_locals(a, 5, 3, 2, 2)
+    as_c = {k: np.ascontiguousarray(v) for k, v in locals_.items()}
+    as_f = {k: np.asfortranarray(v) for k, v in locals_.items()}
+    np.testing.assert_array_equal(gather_locals(desc, as_c, 2, 2), a)
+    np.testing.assert_array_equal(gather_locals(desc, as_f, 2, 2), a)
+
+
+def test_scatter_gather_pure_numpy_interchange(rng):
+    """The checkpoint layer's serialization pair (scatter_locals /
+    gather_locals) is pure numpy — no Grid, no devices — and exact at
+    ragged sizes on 1x1 and 2x2 process splits.  This layout is PINNED
+    as the checkpoint interchange format (robust/checkpoint.py)."""
+    from slate_tpu.compat.scalapack import gather_locals, scatter_locals
+    for (p, q) in ((1, 1), (2, 2), (2, 1)):
+        for (m, n, mb, nb) in ((9, 9, 4, 4), (17, 13, 5, 3), (8, 8, 8, 8)):
+            a = rng.standard_normal((m, n))
+            desc, locals_ = scatter_locals(a, mb, nb, p, q)
+            assert desc[2:6] == (m, n, mb, nb)
+            for piece in locals_.values():
+                assert piece.flags["F_CONTIGUOUS"]
+            np.testing.assert_array_equal(
+                gather_locals(desc, locals_, p, q), a)
+
+
 @pytest.mark.slow
 def test_as_checkpoint_format(rng):
     """to_scalapack doubles as a save/load format: solve after a

@@ -1905,3 +1905,121 @@ def test_output_artifact_schema(tmp_path, capsys):
     assert report["files"] == 1
     assert [f["rule"] for f in _trc_findings(report)] == ["TRC001"]
     capsys.readouterr()
+
+
+# --------------------------------------------------------------------------
+# durability seams (SEAM013) and the TileMap/CheckpointManager locks
+
+
+def test_seam013_fires_on_raw_checkpoint_io_outside_manager(tmp_path):
+    """A driver serializing checkpoint payloads itself (instead of going
+    through CheckpointManager) bypasses the verify ladder and fires
+    SEAM013."""
+    files = seam_skeleton()
+    files["slate_tpu/drivers/lu.py"] = (
+        "from ..robust import health\n"
+        "from ..robust.checkpoint import write_payload\n\n\n"
+        "def _getrf(a):\n    ok = resolve_abft(None)\n    return a\n\n\n"
+        "def getrf(a, opts=None):\n"
+        "    write_payload('/tmp/p', {}, {})\n"
+        "    return health.finalize(a)\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM013"}
+    assert "write_payload" in fs[0].message
+
+
+def test_seam013_silent_inside_checkpoint_and_via_manager(tmp_path):
+    """robust/checkpoint.py is the one sanctioned serialization site; a
+    driver that snapshots through CheckpointManager stays clean."""
+    files = seam_skeleton()
+    files["slate_tpu/robust/checkpoint.py"] = (
+        "def write_payload(path, header, arrays):\n"
+        "    return 'sha', 0\n\n\n"
+        "def read_manifest(d):\n    return {}\n\n\n"
+        "class CheckpointManager:\n"
+        "    def save(self, op, step, m):\n"
+        "        return write_payload('p', {}, {})\n")
+    files["slate_tpu/drivers/lu.py"] = (
+        "from ..robust import health\n"
+        "from ..robust.checkpoint import CheckpointManager\n\n\n"
+        "def _getrf(a):\n    ok = resolve_abft(None)\n    return a\n\n\n"
+        "def getrf(a, opts=None, checkpoint=None):\n"
+        "    if checkpoint is not None:\n"
+        "        checkpoint.save('getrf', 0, a)\n"
+        "    return health.finalize(a)\n")
+    assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
+
+
+TILEMAP_FIXTURE = """\
+import threading
+
+
+class TileMap:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._res = {}
+        self._device = {}
+        self._pending = {}
+
+    def residency(self, key):
+        with self._lock:
+            return self._res.get(key, "host")
+"""
+
+
+def test_con001_fires_on_unlocked_tilemap_residency(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/storage.py": TILEMAP_FIXTURE + (
+            "\n"
+            "    def sneak(self, key, dev):\n"
+            "        self._device[key] = dev\n"
+            "        self._res[key] = 'device'\n")})
+    fs = lint(root, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    assert any("_res" in f.message or "_device" in f.message for f in fs)
+
+
+def test_con001_silent_on_locked_tilemap_residency(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/core/storage.py": TILEMAP_FIXTURE + (
+            "\n"
+            "    def move(self, key, dev):\n"
+            "        with self._lock:\n"
+            "            self._device[key] = dev\n"
+            "            self._res[key] = 'device'\n")})
+    assert lint(root, {"CON001"}) == []
+
+
+def test_con001_mutation_of_real_tilemap_is_caught(tmp_path):
+    """Acceptance mutation for the out-of-core layer: unlock one
+    residency-map access in the real core/storage.py and CON001 fires on
+    the TileMap guard set."""
+    real = (REPO / "slate_tpu/core/storage.py").read_text()
+    good = mini_repo(tmp_path / "good",
+                     {"slate_tpu/core/storage.py": real})
+    assert lint(good, {"CON001"}) == []
+    mutated = real.replace("with self._lock:", "if True:", 1)
+    assert mutated != real
+    bad = mini_repo(tmp_path / "bad",
+                    {"slate_tpu/core/storage.py": mutated})
+    fs = lint(bad, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    guards = ("_res", "_device", "_pending")
+    assert all(any(g in f.message for g in guards) for f in fs)
+
+
+def test_con001_mutation_of_real_checkpoint_seq_is_caught(tmp_path):
+    """Unlock the manifest sequence counter in the real checkpoint.py:
+    a torn _seq is exactly the stale-read hazard the verify ladder keys
+    on, so the lint must hold the line."""
+    real = (REPO / "slate_tpu/robust/checkpoint.py").read_text()
+    good = mini_repo(tmp_path / "good",
+                     {"slate_tpu/robust/checkpoint.py": real})
+    assert lint(good, {"CON001"}) == []
+    mutated = real.replace("with self._lock:", "if True:", 1)
+    assert mutated != real
+    bad = mini_repo(tmp_path / "bad",
+                    {"slate_tpu/robust/checkpoint.py": mutated})
+    fs = lint(bad, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    assert all("_seq" in f.message for f in fs)

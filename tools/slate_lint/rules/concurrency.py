@@ -75,6 +75,10 @@ LOCK_REGISTRY: tuple[LockSpec, ...] = (
              ("_exes", "_hits", "_misses", "_compile_ms")),
     LockSpec("slate_tpu/obs/events.py", None, "_LOCK",
              ("_CFG", "_RING", "_COLLECTORS")),
+    LockSpec("slate_tpu/core/storage.py", "TileMap", "_lock",
+             ("_res", "_device", "_pending")),
+    LockSpec("slate_tpu/robust/checkpoint.py", "CheckpointManager", "_lock",
+             ("_seq",)),
 )
 
 #: constructors run happens-before publication; module top level is

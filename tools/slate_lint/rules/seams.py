@@ -30,9 +30,14 @@ SEAM012 (new, PR 10) — serve/ obtains executables ONLY through the
         anywhere in slate_tpu/serve/ except serve/cache.py, so every
         serving compile is accounted in ExecutableCache.stats and
         surfaced in per-batch obs events
+SEAM013 (new, PR 17) — checkpoint serialization (write_payload /
+        read_payload / write_manifest / read_manifest) is only touched
+        inside slate_tpu/robust/checkpoint.py — the on-disk format,
+        atomic-rename discipline and verification ladder have ONE blast
+        radius; everything else goes through CheckpointManager
 ====== ===============================================================
 
-SEAM011 and SEAM012 have no legacy twins (they postdate the migration);
+SEAM011–SEAM013 have no legacy twins (they postdate the migration);
 their ``legacy`` strings are the modern ``path:line: msg`` form.
 """
 
@@ -81,6 +86,12 @@ SERVE_DIR = "slate_tpu/serve"
 SERVE_CACHE_MODULE = f"{SERVE_DIR}/cache.py"
 #: compile-producing constructs banned outside the serve executable cache
 SERVE_COMPILE_NAMES = {"jit", "lower", "compile", "aot_compile"}
+
+CKPT_MODULE = "slate_tpu/robust/checkpoint.py"
+#: raw checkpoint serialization: everyone else uses CheckpointManager,
+#: so torn-write semantics and the verify ladder have one blast radius
+RAW_CKPT_IO_NAMES = {"write_payload", "read_payload", "write_manifest",
+                     "read_manifest"}
 
 ABFT_MODULE = "slate_tpu/robust/abft.py"
 FAULTS_MODULE = "slate_tpu/robust/faults.py"
@@ -214,6 +225,7 @@ def seam_scan(project) -> list[tuple[str, Finding]]:
     out.extend(_scan_driver_contract(project))
     out.extend(_scan_tune(project))
     out.extend(_scan_serve(project))
+    out.extend(_scan_checkpoint(project))
     project.cache["seam_scan"] = out
     return out
 
@@ -485,6 +497,37 @@ def _scan_serve(project):
                     legacy=f"{rel}:{node.lineno}: {msg}"))
 
 
+def _scan_checkpoint(project):
+    # SEAM013: checkpoint bytes hit disk ONLY through robust/checkpoint.py.
+    # The payload/manifest writers own atomic write-then-rename and the
+    # digest computation; the readers own the torn/stale/corrupt refusal
+    # ladder.  A driver or tool serializing around them produces snapshots
+    # resume() cannot verify.
+    for rel in _slate_modules(project):
+        if rel == CKPT_MODULE:
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            name = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                name = node.id
+            elif isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, (ast.ImportFrom, ast.Import)):
+                aliased = [a.name for a in node.names]
+                hits = RAW_CKPT_IO_NAMES.intersection(aliased)
+                if hits:
+                    name = sorted(hits)[0]
+            if name in RAW_CKPT_IO_NAMES:
+                msg = (f"touches raw checkpoint serialization (`{name}`) "
+                       f"outside slate_tpu/robust/checkpoint.py — go "
+                       f"through CheckpointManager so the on-disk format "
+                       f"and verify ladder have one blast radius")
+                yield ("SEAM013", Finding(
+                    "SEAM013", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: {msg}"))
+
+
 def legacy_report(project) -> list[str]:
     """The pre-migration checker's report lines, in its order, honoring
     per-line suppressions (the legacy checker predates suppressions, so a
@@ -534,3 +577,7 @@ _make("SEAM011", "the raw autotuner plan cache (load/save/cache_path/"
 _make("SEAM012", "serve/ obtains executables only through the serve "
       "cache (serve/cache.py) — no jit/lower/compile elsewhere in the "
       "package, so every serving compile is accounted")
+_make("SEAM013", "checkpoint serialization (write/read payload+manifest) "
+      "only inside robust/checkpoint.py — everyone else goes through "
+      "CheckpointManager, so the format and verify ladder have one "
+      "blast radius")
