@@ -703,6 +703,85 @@ def bench_serve_ragged(problems, nrhs, reps, bucket):
                       "unit": "x", "n": problems}), flush=True)
 
 
+def bench_serve_bf16(problems, nrhs, reps, bucket):
+    """Certified bf16 serving rung vs the f32-only route (PERF r18): one
+    seeded mixed workload (three ops, sizes spanning the bucket, all
+    f32 requests) through two Servers on a single-rung ladder — one with
+    ``Option.Precision = bf16`` (the certified low-precision rung below
+    the f32 ladder, serve/batched.py) and one f32-only.  Reports raw and
+    padding-waste-adjusted problems/s for BOTH routes, the certificate
+    accept-rate over live slots (accepted = not escalated; escalations
+    land on results bit-identical to the f32 route), and the bf16/f32
+    speedup.  On CPU the rung computes both the bf16 attempt and its f32
+    escalation target, so the speedup reads BELOW 1 there — the honest
+    number; the >= 1.6x target is a TPU goal (docs/PERF.md round 18).
+    Emits its own lines: problems/s, % and x, not GFLOP/s."""
+    from slate_tpu import Option, Precision, obs, serve
+    from slate_tpu.serve import bucket as _bucket
+
+    rng = np.random.default_rng(18)
+    ops = ("solve", "chol_solve", "least_squares_solve")
+    szs = (max(bucket // 4, 1), max(bucket // 2, 1), max(bucket - 9, 1),
+           bucket)
+    reqs = []
+    for i in range(problems):
+        n = int(szs[i % len(szs)])
+        op = ops[i % len(ops)]
+        dt = np.float32
+        a = rng.standard_normal((n, n)).astype(dt)
+        if op == "chol_solve":
+            a = (a @ a.T / n + np.eye(n, dtype=dt)).astype(dt)
+        elif op == "solve":
+            a = a + np.eye(n, dtype=dt) * 4.0
+        # least squares keeps m = n so all three ops share the one rung
+        b = rng.standard_normal((n, nrhs)).astype(dt)
+        reqs.append((op, a, b))
+
+    ladder = _bucket.BucketLadder((int(bucket),), "tuned")
+    opts_by_route = {"bf16": {Option.Precision: Precision.Bf16},
+                     "f32": None}
+    stats, accept = {}, None
+    for route, opts in opts_by_route.items():
+        srv = serve.Server(opts=opts, ladder=ladder,
+                           cache=serve.ExecutableCache())
+        _PROGRESS["phase"] = f"compile:{route}"
+        with obs.recording() as warm_events:
+            srv.serve_batch(reqs)          # compiles every bucket
+        _PROGRESS["phase"] = f"run:{route}"
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            srv.serve_batch(reqs)
+            times.append(time.perf_counter() - t0)
+        ev = [e for e in warm_events if e.get("kind") == "serve_batch"]
+        waste = (sum(e["padding_waste"] * e["problems"] for e in ev)
+                 / max(sum(e["problems"] for e in ev), 1))
+        stats[route] = (problems / min(times), float(waste))
+        if route == "bf16":
+            live = max(sum(e["problems"] for e in ev), 1)
+            esc = sum(e["escalated"] for e in ev)
+            accept = 1.0 - esc / live
+
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    for route, (raw, waste) in stats.items():
+        print(json.dumps({
+            **base, "metric": f"serve_precision_{route}_problems_per_s",
+            "value": round(float(raw), 2), "unit": "problems/s",
+            "n": problems}), flush=True)
+        print(json.dumps({
+            **base,
+            "metric": f"serve_precision_{route}_adjusted_problems_per_s",
+            "value": round(float(raw / max(1.0 - waste, 1e-9)), 2),
+            "unit": "problems/s", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_precision_accept_rate_pct",
+                      "value": round(100.0 * float(accept), 2),
+                      "unit": "%", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_precision_bf16_speedup",
+                      "value": round(stats["bf16"][0]
+                                     / max(stats["f32"][0], 1e-9), 3),
+                      "unit": "x", "n": problems}), flush=True)
+
+
 def bench_serve_survival(problems, rate_hz, nrhs, sizes, budget_ms):
     """Survival-layer throughput (robustness PR): a seeded Poisson
     arrival stream (robust.faults.poisson_workload) replayed against a
@@ -865,6 +944,7 @@ QUICK_STEPS = [
     (bench_serve_mixed, dict(problems=24, nrhs=4, reps=2,
                              sizes=(24, 48, 96))),
     (bench_serve_ragged, dict(problems=12, nrhs=4, reps=2, bucket=32)),
+    (bench_serve_bf16, dict(problems=12, nrhs=4, reps=2, bucket=32)),
     (bench_serve_survival, dict(problems=24, rate_hz=400.0, nrhs=4,
                                 sizes=(24, 48), budget_ms=5000.0)),
     (bench_potrf_ooc, dict(n=192, nb=64, iters=2)),
@@ -891,6 +971,7 @@ FULL_STEPS = [
     (bench_serve_mixed, dict(problems=96, nrhs=16, reps=3,
                              sizes=(48, 96, 160, 320))),
     (bench_serve_ragged, dict(problems=48, nrhs=16, reps=3, bucket=256)),
+    (bench_serve_bf16, dict(problems=48, nrhs=16, reps=3, bucket=256)),
     (bench_serve_survival, dict(problems=192, rate_hz=800.0, nrhs=16,
                                 sizes=(48, 96, 160), budget_ms=2000.0)),
     (bench_potrf_ooc, dict(n=4096, nb=512, iters=3)),

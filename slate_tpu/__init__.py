@@ -17,11 +17,11 @@ from .types import Diag, Layout, Norm, Op, Side, TileKind, Uplo  # noqa: F401
 from .options import (  # noqa: F401
     ErrorPolicy, GridOrder, MethodCholQR, MethodEig, MethodGels, MethodGemm,
     MethodHemm, MethodLU, MethodSvd, MethodTrsm, NormScope, Option,
-    Speculate, Target,
+    Precision, Speculate, Target,
 )
 from .exceptions import (  # noqa: F401
     SlateError, SlateNotConvergedError, SlateNotPositiveDefiniteError,
-    SlateSingularError, SlateValueError,
+    SlateSingularError, SlateUnsupportedDtypeError, SlateValueError,
 )
 from . import robust  # noqa: F401
 from .robust.health import HealthInfo  # noqa: F401

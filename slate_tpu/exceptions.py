@@ -17,6 +17,20 @@ class SlateValueError(SlateError, ValueError):
     """Invalid argument (shape/uplo/op mismatches)."""
 
 
+class SlateUnsupportedDtypeError(SlateValueError):
+    """A boundary was handed a dtype it cannot serve.
+
+    Raised by ``robust.precision.normalize_dtype`` when a caller names a
+    dtype outside the boundary's supported set (e.g. float64 at the
+    serving front door).  The contract is refuse-loudly: an unsupported
+    dtype must never silently take a slow or wrong-precision route.
+    ``dtype`` carries the canonical spelling that was rejected."""
+
+    def __init__(self, msg: str, dtype: str = ""):
+        super().__init__(msg)
+        self.dtype = dtype
+
+
 class SlateNotConvergedError(SlateError):
     """Iterative routine failed to converge (ref: gesv_mixed itermax path)."""
 

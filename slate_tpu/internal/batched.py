@@ -36,7 +36,13 @@ only when `tune.resolve_plan` hands back a Pallas plan for the
 `batch_potrf`/`batch_getrf`/`batch_geqrf` ops (SEAM011) — nothing else
 imports these drivers for dispatch.
 
-Real f32 only (the Pallas panels' contract); callers gate on dtype.
+Real f32 or bf16 storage (the Pallas panels' contract); callers gate on
+dtype via the serving route's normalized check.  On bf16 input every
+panel accumulates in f32 inside the kernel, and the XLA glue between
+panels (U12 solves, WY trailing updates, the solve readers) promotes
+factor blocks to f32, computes, and demotes only the values stored back
+in bf16 — solves against a bf16 factor always RETURN f32 (the refine
+side of the factor-low/refine-high split; robust/precision.py).
 """
 
 from __future__ import annotations
@@ -52,6 +58,12 @@ from .pallas_lu import lu_panel_batched
 from .pallas_qr import qr_panel_batched
 
 _HI = lax.Precision.HIGHEST
+
+
+def _f32(x):
+    """Promote a factor block to f32 for the XLA glue between panels —
+    a no-op on f32 input, so the f32 route's numerics are unchanged."""
+    return x.astype(jnp.float32)
 
 
 def tile_counts(sizes, nb: int):
@@ -126,22 +138,26 @@ def batch_getrf(a, sizes, *, nb: int, bw: int = 8,
             # rows, zero L10 rows) and the unit-lower solve against the
             # block-diagonal L11 never mixes padding and live rows, so
             # the padding region stays exactly 0.
-            r = fa[:, k0:k1, k1:] - jnp.matmul(
-                fa[:, k0:k1, :k0], fa[:, :k0, k1:], precision=_HI)
+            r = _f32(fa[:, k0:k1, k1:]) - jnp.matmul(
+                _f32(fa[:, k0:k1, :k0]), _f32(fa[:, :k0, k1:]),
+                precision=_HI)
             u12 = lax.linalg.triangular_solve(
-                fac[:, :nb], r, left_side=True, lower=True,
+                _f32(fac[:, :nb]), r, left_side=True, lower=True,
                 unit_diagonal=True)
-            fa = fa.at[:, k0:k1, k1:].set(u12)
+            fa = fa.at[:, k0:k1, k1:].set(u12.astype(a.dtype))
     return fa
 
 
 def batch_getrs(fa, b):
     """Solve with a batched packed no-pivot L\\U: unit-lower forward
     substitution then upper back substitution.  fa [B, n, n], b
-    [B, n, k]."""
-    y = lax.linalg.triangular_solve(fa, b, left_side=True, lower=True,
+    [B, n, k].  A bf16 factor is promoted and solved in f32 (the result
+    follows ``b``'s dtype, the refine-side precision)."""
+    fh = _f32(fa)
+    y = lax.linalg.triangular_solve(fh, _f32(b), left_side=True, lower=True,
                                     unit_diagonal=True)
-    return lax.linalg.triangular_solve(fa, y, left_side=True, lower=False)
+    x = lax.linalg.triangular_solve(fh, y, left_side=True, lower=False)
+    return x.astype(b.dtype)
 
 
 def batch_geqrf(a, rows, *, nb: int, interpret: bool = False):
@@ -165,36 +181,37 @@ def batch_geqrf(a, rows, *, nb: int, interpret: bool = False):
         packed = packed.at[:, j0:, j0:j1].set(pk)
         ts.append(t)
         if j1 < n:
-            v = jnp.tril(pk, -1) + jnp.eye(m, w, dtype=a.dtype)[None]
-            c = packed[:, j0:, j1:]
+            v = _f32(jnp.tril(pk, -1)) + jnp.eye(m, w, dtype=jnp.float32)[None]
+            c = _f32(packed[:, j0:, j1:])
             g = jnp.matmul(jnp.swapaxes(v, 1, 2), c, precision=_HI)
-            g = jnp.matmul(jnp.swapaxes(t, 1, 2), g, precision=_HI)
+            g = jnp.matmul(jnp.swapaxes(_f32(t), 1, 2), g, precision=_HI)
             packed = packed.at[:, j0:, j1:].set(
-                c - jnp.matmul(v, g, precision=_HI))
+                (c - jnp.matmul(v, g, precision=_HI)).astype(a.dtype))
     return packed, jnp.stack(ts, axis=1)
 
 
 def batch_gels(a, b, rows, *, nb: int, interpret: bool = False):
     """Ragged batched least squares via batch_geqrf: minimize
     ||a_i x_i - b_i|| per problem.  a [B, mb, n], b [B, mb, k], returns
-    ``(x [B, n, k], packed)`` with x = R^-1 (Q^T b)[:n]."""
+    ``(x [B, n, k], packed)`` with x = R^-1 (Q^T b)[:n].  A bf16 factor
+    applies Q^T and solves against R in f32 (x follows ``b``'s dtype)."""
     bsz, mb, n = a.shape
     packed, ts = batch_geqrf(a, rows, nb=nb, interpret=interpret)
     w = ts.shape[2]
-    y = b
+    y = _f32(b)
     for j in range(n // w):
         j0 = j * w
         m = mb - j0
         pk = packed[:, j0:, j0:j0 + w]
-        v = jnp.tril(pk, -1) + jnp.eye(m, w, dtype=a.dtype)[None]
-        t = ts[:, j]
+        v = _f32(jnp.tril(pk, -1)) + jnp.eye(m, w, dtype=jnp.float32)[None]
+        t = _f32(ts[:, j])
         c = y[:, j0:]
         g = jnp.matmul(jnp.swapaxes(v, 1, 2), c, precision=_HI)
         g = jnp.matmul(jnp.swapaxes(t, 1, 2), g, precision=_HI)
         y = y.at[:, j0:].set(c - jnp.matmul(v, g, precision=_HI))
-    x = lax.linalg.triangular_solve(packed[:, :n, :n], y[:, :n],
+    x = lax.linalg.triangular_solve(_f32(packed[:, :n, :n]), y[:, :n],
                                     left_side=True, lower=False)
-    return x, packed
+    return x.astype(b.dtype), packed
 
 
 def batch_chol_health(fa):

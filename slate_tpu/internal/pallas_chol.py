@@ -36,7 +36,13 @@ problem computes only its own live tiles; dead tiles identity-complete
 by copying their input through, so a bucket of mixed-size problems
 never burns MXU cycles on padding.
 
-Real f32 only; complex/f64 tiles use the XLA fallback (potrf_tile).
+Real f32 tiles everywhere; the batched variant additionally accepts
+bf16 storage with fp32 accumulation — every MXU dot carries
+``preferred_element_type=f32``, the VMEM accumulator and the factor
+scratch are f32, and only the final panel write demotes back to the
+input dtype (the MXU's native bf16xbf16->f32 contract; the certified
+acceptance story lives in serve/batched.py + robust/precision.py).
+Complex/f64 tiles use the XLA fallback (potrf_tile).
 """
 
 from __future__ import annotations
@@ -197,6 +203,7 @@ def _chol_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
     kc = pl.num_programs(2)
     nb = col_ref.shape[1]
     dt = col_ref.dtype
+    f32 = jnp.float32
     # Row tile i of this panel is global tile k + i of problem b; tiles
     # past the problem's own count are DEAD — identity-augmented packing
     # makes their factor exactly the input tile (I on the diagonal, 0
@@ -205,20 +212,22 @@ def _chol_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[:] = col_ref[0]
+        # accumulate in f32 regardless of storage dtype (bf16 inputs ride
+        # the MXU's native bf16xbf16->f32 path; f32 inputs are unchanged)
+        acc_ref[:] = col_ref[0].astype(f32)
 
     @pl.when(live)
     def _update():
         # left-looking rank-k chunk: acc -= A[b, i-tile, chunk] @ lead
         acc_ref[:] = acc_ref[:] - jnp.dot(left_ref[0], lead_ref[0],
-                                          preferred_element_type=dt,
+                                          preferred_element_type=f32,
                                           precision=_HI)
 
     @pl.when(j == kc - 1)
     def _finish():
         @pl.when(live)
         def _live():
-            upd_ref[0] = acc_ref[:]          # pre-factor tile (ABFT rungs)
+            upd_ref[0] = acc_ref[:].astype(dt)   # pre-factor tile (ABFT)
 
             @pl.when(i == 0)
             def _factor():
@@ -226,17 +235,17 @@ def _chol_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
                 u = acc_ref[:]
                 eye = (lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
                        == lax.broadcasted_iota(jnp.int32, (nb, nb), 1))
-                fac_ref[0] = lax.dot_general(u, eye.astype(dt),
+                fac_ref[0] = lax.dot_general(u, eye.astype(f32),
                                              (((0,), (0,)), ((), ())),
-                                             preferred_element_type=dt,
-                                             precision=_HI)
+                                             preferred_element_type=f32,
+                                             precision=_HI).astype(dt)
                 uinv_ref[:] = upper_tri_inv(u)
 
             @pl.when(i != 0)
             def _trsm():
                 fac_ref[0] = jnp.dot(acc_ref[:], uinv_ref[:],
-                                     preferred_element_type=dt,
-                                     precision=_HI)
+                                     preferred_element_type=f32,
+                                     precision=_HI).astype(dt)
 
         @pl.when(jnp.logical_not(live))
         def _dead():
@@ -264,7 +273,8 @@ def chol_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
     exact identity-completion values), keeping HBM initialized.
 
     Returns (upd, fac) stacked over B, same per-problem contract as
-    chol_panel_fused.  Caller guarantees f32, M % nb == 0, nb % bw == 0.
+    chol_panel_fused.  Caller guarantees real f32 OR bf16 storage
+    (accumulation is f32 either way), M % nb == 0, nb % bw == 0.
     """
     bsz, m, nb = col.shape
     kk = left.shape[2]
@@ -292,8 +302,8 @@ def chol_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
                 pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
                 pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
             ],
-            scratch_shapes=[pltpu.VMEM((nb, nb), col.dtype),
-                            pltpu.VMEM((nb, nb), col.dtype)],
+            scratch_shapes=[pltpu.VMEM((nb, nb), jnp.float32),
+                            pltpu.VMEM((nb, nb), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct((bsz, m, nb), col.dtype),
                    jax.ShapeDtypeStruct((bsz, m, nb), col.dtype)],

@@ -31,7 +31,11 @@ dimension and per-problem tile counts via scalar prefetch — dead tiles
 identity-complete by copying their input through, so mixed-size batches
 skip the padding work entirely.
 
-Real f32 only; the XLA LU remains the fallback (and the test oracle).
+Real f32 everywhere; the batched variant additionally accepts bf16
+storage with fp32 accumulation (f32 VMEM accumulator + factor scratch,
+``preferred_element_type=f32`` on every MXU dot, demote on the final
+panel write — see pallas_chol.py for the contract).  The XLA LU remains
+the fallback (and the test oracle).
 """
 
 from __future__ import annotations
@@ -229,6 +233,7 @@ def _lu_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
     j = pl.program_id(2)
     kc = pl.num_programs(2)
     dt = col_ref.dtype
+    f32 = jnp.float32
     # Tiles past problem b's own count are DEAD: identity-augmented
     # packing makes their no-pivot LU exactly the input tile (the
     # diagonal tile is I = its own packed L\\U, off-diagonal tiles are
@@ -237,32 +242,35 @@ def _lu_panel_batched_kernel(tiles_ref, col_ref, left_ref, lead_ref,
 
     @pl.when(j == 0)
     def _init():
-        acc_ref[:] = col_ref[0]
+        # f32 accumulation regardless of storage dtype (bf16 inputs ride
+        # the MXU's native bf16xbf16->f32 path; f32 inputs unchanged)
+        acc_ref[:] = col_ref[0].astype(f32)
 
     @pl.when(live)
     def _update():
         # left-looking rank-k chunk: acc -= L[b, i-tile, chunk] @ U chunk
         acc_ref[:] = acc_ref[:] - jnp.dot(left_ref[0], lead_ref[0],
-                                          preferred_element_type=dt,
+                                          preferred_element_type=f32,
                                           precision=_HI)
 
     @pl.when(j == kc - 1)
     def _finish():
         @pl.when(live)
         def _live():
-            upd_ref[0] = acc_ref[:]          # pre-factor tile
+            upd_ref[0] = acc_ref[:].astype(dt)   # pre-factor tile
 
             @pl.when(i == 0)
             def _factor():
                 _lu_factor_in_place(acc_ref, bw=bw)
-                fac_ref[0] = acc_ref[:]
+                fac_ref[0] = acc_ref[:].astype(dt)
                 uinv_ref[:] = upper_tri_inv(acc_ref[:])
 
             @pl.when(i != 0)
             def _trsm():
                 fac_ref[0] = jnp.dot(acc_ref[:], uinv_ref[:],
-                                     preferred_element_type=dt,
-                                     precision=_HI)   # L21 = A21 U^-1
+                                     preferred_element_type=f32,
+                                     precision=_HI).astype(dt)
+                # L21 = A21 U^-1
 
         @pl.when(jnp.logical_not(live))
         def _dead():
@@ -287,7 +295,8 @@ def lu_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
     stream's index map clamps dead tiles onto the last live row so no
     fresh HBM->VMEM copies are issued for them.  Returns (upd, fac) with
     lu_panel_fused's packed L\\U contract per problem (unit lower
-    implied).  Caller guarantees f32, M % nb == 0, nb % bw == 0.
+    implied).  Caller guarantees real f32 OR bf16 storage (accumulation
+    is f32 either way), M % nb == 0, nb % bw == 0.
     """
     bsz, m, nb = col.shape
     kk = left.shape[2]
@@ -315,8 +324,8 @@ def lu_panel_batched(col, left, lead, tiles, k: int = 0, bw: int = 8,
                 pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
                 pl.BlockSpec((1, nb, nb), lambda b, i, j, tiles: (b, i, 0)),
             ],
-            scratch_shapes=[pltpu.VMEM((nb, nb), col.dtype),
-                            pltpu.VMEM((nb, nb), col.dtype)],
+            scratch_shapes=[pltpu.VMEM((nb, nb), jnp.float32),
+                            pltpu.VMEM((nb, nb), jnp.float32)],
         ),
         out_shape=[jax.ShapeDtypeStruct((bsz, m, nb), col.dtype),
                    jax.ShapeDtypeStruct((bsz, m, nb), col.dtype)],

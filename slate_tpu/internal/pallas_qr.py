@@ -20,7 +20,11 @@ prefetch.  Unlike Cholesky/LU, padding columns carry real reflectors
 (the identity augmentation must be annihilated), so raggedness is
 problem-granular: only zero-row filler slots skip the factorization.
 
-Real f32 only, mm >= w; other panels use the XLA path (qr.geqrf_panel).
+Real f32, mm >= w; the batched variant additionally accepts bf16
+storage — the panel is upcast once into VMEM, the whole column loop
+(larfg scalars, trailing updates, T recursion) runs in f32, and only
+the final packed/T writes demote back (see pallas_chol.py for the
+accumulation contract).  Other panels use the XLA path (qr.geqrf_panel).
 """
 
 from __future__ import annotations
@@ -103,9 +107,11 @@ def _qr_panel_batched_kernel(rows_ref, a_ref, p_ref, t_ref):
 
     @pl.when(live)
     def _panel():
-        packed, t = _qr_panel_steps(a_ref[0])
-        p_ref[0] = packed
-        t_ref[0] = t
+        # column loop in f32 (bf16 panels upcast once into registers);
+        # the packed/T writes demote back to the storage dtype
+        packed, t = _qr_panel_steps(a_ref[0].astype(jnp.float32))
+        p_ref[0] = packed.astype(dt)
+        t_ref[0] = t.astype(dt)
 
     @pl.when(jnp.logical_not(live))
     def _dead():
@@ -142,7 +148,8 @@ def qr_panel_batched(a, rows, interpret: bool = False):
     grids): identity-augmented padding columns own real reflectors, so a
     live problem factors its whole bucket panel; a problem with
     rows[b] == 0 (a filler slot) passes its input through with T = 0.
-    Returns (packed [B, mm, w], T [B, w, w])."""
+    Accepts real f32 or bf16 storage (the column loop runs in f32 either
+    way).  Returns (packed [B, mm, w], T [B, w, w])."""
     bsz, mm, w = a.shape
     packed, t = pl.pallas_call(
         _qr_panel_batched_kernel,

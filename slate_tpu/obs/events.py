@@ -291,7 +291,8 @@ def _build(frame: _Frame, error) -> dict:
     mfu = gbps = None
     if frame.device_ms:
         secs = frame.device_ms * 1e-3
-        mfu = _flops.mfu(_flops.op_flops(op, frame.shapes), secs)
+        mfu = _flops.mfu(_flops.op_flops(op, frame.shapes), secs,
+                         frame.dtype)
         gbps = _flops.achieved_gbps(
             _flops.op_bytes(op, frame.shapes, frame.dtype), secs)
     return {

@@ -38,14 +38,29 @@ import threading
 
 _MODELS: dict = {}
 _PEAK_LOCK = threading.Lock()
-_PEAK: list = [False, None]        # [resolved?, value] — lazy, cached
+_PEAK: dict = {}                   # dtype name -> cached peak (or None)
 _PEAK_OVERRIDE: list = [None]
 
-#: public spec-sheet dense-matmul peaks (bf16 MXU; XLA's default f32
-#: matmul runs single-pass at the same rate) per chip generation
-PEAK_TABLE = (("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12),
-              ("v5e", 197e12), ("v4", 275e12), ("v3", 123e12),
-              ("v2", 46e12))
+#: public spec-sheet dense-matmul peaks per chip generation, keyed by
+#: STORAGE dtype.  bf16 is the native MXU rate; f32 lists the same value
+#: because XLA's default f32 matmul runs single-pass on the MXU (a
+#: HIGHEST-precision f32 matmul is multi-pass and lands below it, which
+#: MFU then honestly under-reports).  float64 has no MXU path on any
+#: listed chip and is deliberately ABSENT: a f64 batch reads ``mfu: n/a``
+#: rather than a number against a peak the hardware cannot reach.
+PEAK_TABLE = (
+    ("v6", {"bfloat16": 918e12, "float32": 918e12}),
+    ("v5p", {"bfloat16": 459e12, "float32": 459e12}),
+    ("v5 lite", {"bfloat16": 197e12, "float32": 197e12}),
+    ("v5e", {"bfloat16": 197e12, "float32": 197e12}),
+    ("v4", {"bfloat16": 275e12, "float32": 275e12}),
+    ("v3", {"bfloat16": 123e12, "float32": 123e12}),
+    ("v2", {"bfloat16": 46e12, "float32": 46e12}),
+)
+
+#: dtype assumed when a caller does not say (the historical single-peak
+#: behavior: every chip's headline number is its bf16 rate)
+DEFAULT_PEAK_DTYPE = "bfloat16"
 
 
 def register(*names):
@@ -112,28 +127,48 @@ def _prod(shape) -> float:
 # ---------------------------------------------------------------- peak
 
 
-def chip_peak():
+def _peak_dtype(dtype) -> str:
+    """Normalize a peak-table dtype key through the one shared spelling
+    helper (robust/precision.py).  Observability must never throw, so an
+    unrecognized spelling degrades to itself — it simply misses the
+    table and reads ``mfu: n/a``."""
+    if dtype is None:
+        return DEFAULT_PEAK_DTYPE
+    from ..robust.precision import normalize_dtype
+    try:
+        return normalize_dtype(dtype)
+    except Exception:
+        return str(dtype)
+
+
+def chip_peak(dtype=None):
     """(dense-matmul peak FLOP/s or None, device kind) for the local
-    accelerator — PEAK_TABLE keyed by the jax device kind."""
+    accelerator — PEAK_TABLE keyed by the jax device kind and the
+    storage ``dtype`` (default bf16, the headline rate).  A dtype with
+    no table entry for the chip (e.g. float64) reads None."""
+    dt = _peak_dtype(dtype)
     try:
         import jax
         kind = jax.devices()[0].device_kind.lower()
     except Exception:                        # no backend at all
         return None, "cpu"
-    for key, peak in PEAK_TABLE:
+    for key, peaks in PEAK_TABLE:
         if key in kind:
-            return peak, kind
+            return peaks.get(dt), kind
     return None, kind
 
 
-def peak() -> float | None:
-    """The cached chip peak (FLOP/s), honoring :func:`peak_override`."""
+def peak(dtype=None) -> float | None:
+    """The cached chip peak (FLOP/s) for ``dtype`` (default bf16),
+    honoring :func:`peak_override` — an override pins EVERY dtype, so
+    off-accelerator tests keep working unchanged."""
     if _PEAK_OVERRIDE[0] is not None:
         return _PEAK_OVERRIDE[0]
+    dt = _peak_dtype(dtype)
     with _PEAK_LOCK:
-        if not _PEAK[0]:
-            _PEAK[0], _PEAK[1] = True, chip_peak()[0]
-        return _PEAK[1]
+        if dt not in _PEAK:
+            _PEAK[dt] = chip_peak(dt)[0]
+        return _PEAK[dt]
 
 
 @contextlib.contextmanager
@@ -147,10 +182,12 @@ def peak_override(value: float | None):
         _PEAK_OVERRIDE[0] = prev
 
 
-def mfu(flops: float | None, seconds: float | None) -> float | None:
-    """flops / seconds as a fraction of the chip peak, or None when any
-    ingredient (flops model, timing, known peak) is missing."""
-    p = peak()
+def mfu(flops: float | None, seconds: float | None,
+        dtype=None) -> float | None:
+    """flops / seconds as a fraction of the chip peak for ``dtype``
+    (default bf16 — the historical single-peak behavior), or None when
+    any ingredient (flops model, timing, known peak) is missing."""
+    p = peak(dtype)
     if not flops or not seconds or seconds <= 0 or not p:
         return None
     return round(flops / seconds / p, 4)

@@ -99,6 +99,30 @@ class Abft(enum.Enum):
     On = "on"
 
 
+class Precision(enum.Enum):
+    """Working-precision policy for the certified low-precision rung
+    (robust/precision.py, docs/ROBUSTNESS.md).
+
+    ``Precision.Bf16`` makes bf16 the *first rung* of the escalation
+    ladders: factor in bf16 storage with fp32 accumulation on the MXU,
+    refine in f32, accept only on an a-posteriori certificate
+    (robust/certify), and escalate per problem to the full-precision
+    route on certificate failure.  The knob is resolved ONCE at each
+    driver/serving boundary by ``robust.precision.resolve_precision``
+    (the ErrorPolicy / Speculate / Abft discipline); every cast below
+    the boundary goes through the ``robust/precision.py`` seam
+    (slate-lint SEAM014).
+
+    Auto    currently F32 (the heuristic seam for future auto-enabling)
+    F32     full working precision everywhere (default)
+    Bf16    certified bf16 first rung, f32 escalation
+    """
+
+    Auto = "auto"
+    F32 = "f32"
+    Bf16 = "bf16"
+
+
 class Option(enum.Enum):
     """Option keys (ref: enums.hh:69-101)."""
 
@@ -112,6 +136,7 @@ class Option(enum.Enum):
     ErrorPolicy = "error_policy"
     Speculate = "speculate"
     Abft = "abft"
+    Precision = "precision"
     UseFallbackSolver = "use_fallback_solver"
     PivotThreshold = "pivot_threshold"
     MethodGemm = "method_gemm"
@@ -231,6 +256,7 @@ _DEFAULTS = {
     Option.ErrorPolicy: ErrorPolicy.Raise,
     Option.Speculate: Speculate.Auto,
     Option.Abft: Abft.Auto,
+    Option.Precision: Precision.Auto,
     Option.UseFallbackSolver: True,
     Option.PivotThreshold: 1.0,
     Option.MethodGemm: MethodGemm.Auto,
@@ -256,7 +282,8 @@ _UNSET = object()
 # uniformly ({Option.Target: "mesh"}, {Option.ErrorPolicy: "info"}) and
 # coerced here so every consumer sees the enum.
 _ENUM_VALUED = {Option.Target: Target, Option.ErrorPolicy: ErrorPolicy,
-                Option.Speculate: Speculate, Option.Abft: Abft}
+                Option.Speculate: Speculate, Option.Abft: Abft,
+                Option.Precision: Precision}
 
 
 def get_option(opts: Options | None, key: Option,

@@ -2,7 +2,7 @@
 a-posteriori certification, driver-level recovery/escalation, and
 durable panel-boundary checkpoints.
 
-Five parts (see docs/ROBUSTNESS.md for the per-driver contract table):
+Six parts (see docs/ROBUSTNESS.md for the per-driver contract table):
 
 - :mod:`health`   — the ``HealthInfo`` pytree threaded through the factor
   and solve drivers, plus the ``Option.ErrorPolicy`` resolution that
@@ -10,6 +10,10 @@ Five parts (see docs/ROBUSTNESS.md for the per-driver contract table):
 - :mod:`certify`  — cheap a-posteriori residual/orthogonality certificates
   for the spectral drivers (heev/svd/hetrf), whose decompositions carry no
   pivot record to read failure from.
+- :mod:`precision` — the working-precision policy seam:
+  ``Option.Precision`` resolved once per boundary, dtype spellings
+  canonicalized in one helper, and the sanctioned demote/promote casts
+  for the certified bf16 first rung (slate-lint SEAM014).
 - :mod:`faults`   — a deterministic, seeded fault injector that corrupts
   named sites (input tiles, post-panel factors, post-collective results,
   the two-stage spectral pipeline) so detection and recovery paths are
@@ -31,6 +35,9 @@ from .health import (  # noqa: F401
 )
 from .certify import (  # noqa: F401
     certify_eig, certify_ldlt, certify_svd, tolerance,
+)
+from .precision import (  # noqa: F401
+    normalize_dtype, resolve_precision,
 )
 from .faults import FaultPlan, inject, maybe_corrupt  # noqa: F401
 from .recovery import (  # noqa: F401

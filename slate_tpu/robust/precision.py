@@ -1,0 +1,101 @@
+"""Precision policy seam: the one place dtype decisions are made.
+
+SLATE's mixed-precision drivers (ref: src/gesv_mixed.cc) hard-wire the
+factor-low/refine-high split into one driver.  Here the split is a
+*policy knob* resolved once per boundary — ``Option.Precision`` is read
+exactly like ErrorPolicy / Speculate / Abft (options.py), and every
+cast between working precisions in ``drivers/`` / ``serve/`` goes
+through this module's helpers (slate-lint SEAM014).  That gives three
+guarantees the ad-hoc version cannot:
+
+- drivers never read the raw knob, so a boundary's precision decision
+  is visible in the flight recorder (``note_resolved("precision", ...)``)
+  and cannot silently diverge between rungs;
+- dtype spellings are canonicalized in ONE helper (``normalize_dtype``)
+  shared by the serving gate, tune plan keys, and bucket ladders — the
+  ``jnp.bfloat16``-object vs ``"bfloat16"``-string confusion that made
+  the old serving gate silently fall back is structurally gone;
+- the bf16 rung is *certified*: ``demote``/``promote``/``round_through``
+  are value casts only — acceptance is decided a-posteriori by
+  robust/certify, never by the cast site.
+
+The low precision is bf16 with fp32 accumulation (the MXU's native
+contract; see internal/pallas_chol.py); fp16 is deliberately absent
+until a driver certifies it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..exceptions import SlateUnsupportedDtypeError
+from ..options import Option, Options, Precision, get_option
+
+# canonical spellings of the two working precisions of the bf16 rung
+HIGH = "float32"
+LOW = "bfloat16"
+
+# spellings accepted anywhere a dtype crosses a boundary; values are the
+# canonical form.  np.dtype() handles objects/strings; this table only
+# catches spellings np.dtype would mangle or reject.
+_ALIASES = {"bf16": "bfloat16", "f32": "float32", "fp32": "float32",
+            "f64": "float64", "fp64": "float64"}
+
+
+def normalize_dtype(dtype, *, supported: tuple[str, ...] | None = None) -> str:
+    """Canonicalize a dtype spelling (``jnp.bfloat16`` object, np.dtype,
+    array ``.dtype``, or string) to its numpy name — the ONE spelling the
+    serving gate, ``tune.plans.plan_key`` and ``serve.bucket
+    .default_ladder`` all key on.  With ``supported`` given, a canonical
+    name outside the set raises :class:`SlateUnsupportedDtypeError`
+    instead of letting the caller quietly take a slow route."""
+    name = getattr(dtype, "name", None)
+    if not isinstance(name, str):
+        spelled = _ALIASES.get(dtype, dtype) if isinstance(dtype, str) else dtype
+        try:
+            name = np.dtype(spelled).name
+        except TypeError as exc:
+            # slate-lint: disable=TRC006 -- host dtype spelling gate: fails at trace time, never in-graph
+            raise SlateUnsupportedDtypeError(
+                f"unrecognized dtype spelling {dtype!r}", str(dtype)) from exc
+    if supported is not None and name not in supported:
+        # slate-lint: disable=TRC006 -- static dtype gate: fails at trace time, never in-graph
+        raise SlateUnsupportedDtypeError(
+            f"dtype {name} not supported here (supported: "
+            f"{', '.join(supported)})", name)
+    return name
+
+
+def resolve_precision(opts: Options | None) -> bool:
+    """Resolve Option.Precision ONCE at a driver/serving boundary (the
+    ErrorPolicy / Speculate / Abft discipline): True only for an explicit
+    ``Precision.Bf16`` — ``Auto`` currently maps to F32 so default
+    numerics are unchanged.  Every consumer below the boundary receives
+    the decision, never the knob."""
+    resolved = get_option(opts, Option.Precision) is Precision.Bf16
+    from ..obs import events as _obs_events
+    _obs_events.note_resolved("precision", resolved)
+    return resolved
+
+
+def demote(x):
+    """Cast to the low working precision (bf16 storage).  The sanctioned
+    cast site for the speculative rung's factor inputs."""
+    import jax.numpy as jnp
+    return x.astype(jnp.bfloat16)
+
+
+def promote(x):
+    """Cast to the high working precision (f32) — the refine/certify
+    side of the factor-low/refine-high split."""
+    import jax.numpy as jnp
+    return x.astype(jnp.float32)
+
+
+def round_through(x):
+    """Round a value through bf16 storage and back to its own dtype:
+    models what surviving a bf16 memory hop costs, without changing the
+    array's type.  Exact for values representable in bf16 (identity
+    blocks, zero padding), a half-ulp-of-bf16 perturbation otherwise."""
+    import jax.numpy as jnp
+    return x.astype(jnp.bfloat16).astype(x.dtype)

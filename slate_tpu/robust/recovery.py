@@ -31,6 +31,12 @@ the same shape everywhere a cheap method can fail on hard inputs:
   (:func:`gels_with_recovery`), hesv tries Cholesky first
   (:func:`hesv_with_recovery`) — each attempt certified a-posteriori
   (:mod:`certify`) so a wrong fast answer escalates instead of escaping.
+- Precision (``Option.Precision = bf16``, resolved once per boundary via
+  :func:`precision.resolve_precision`): with Speculate also on, posv and
+  gels grow a rung BELOW the f32 ladder — factor the bf16-ROUNDED
+  operand (:func:`_chol_bf16_attempt` / :func:`_gels_bf16_attempt`),
+  refine in the ORIGINAL f32 system, and certify at the f32 tolerance;
+  a failed certificate escalates to the unchanged f32 chain.
 
 Escalation requires host control flow, so it engages only on EAGER calls;
 traced calls run the requested method once and surface health per
@@ -47,6 +53,7 @@ from ..options import (ErrorPolicy, MethodEig, MethodGels, MethodLU,
                        resolve_speculate, select_gels_method,
                        select_lu_method)
 from . import health as _h
+from .precision import resolve_precision
 
 
 def _with(opts: Options | None, **kv) -> dict:
@@ -209,33 +216,89 @@ def _chol_attempt(A, B, opts):
     return (L, X), _h.merge(fh, _h.from_result(X.storage.data))
 
 
+def _round_bf16(M):
+    """Round a matrix's values through bf16 storage (precision.py
+    round_through) — the dense model of factor-low storage: the values a
+    bf16-resident copy would hold, kept in the caller's dtype so every
+    driver below runs unchanged.  ``with_dense`` preserves the concrete
+    matrix class, so triangular factors stay triangular."""
+    from .precision import round_through
+    return M.with_dense(round_through(M.to_dense()))
+
+
+def _chol_bf16_attempt(A, B, opts, ir_steps: int = 2):
+    """The speculative posv fast path one precision lower
+    (Option.Speculate + Option.Precision = bf16): Cholesky of the
+    bf16-ROUNDED operand with the factor itself bf16-rounded — the dense
+    model of the serving rung's bf16-stored factor (serve/batched.py) —
+    then ``ir_steps`` refinement sweeps in the ORIGINAL f32 system and an
+    a-posteriori residual certificate at the f32 tolerance.  A failed
+    certificate (or a non-HPD rounding) escalates to the unchanged f32
+    Cholesky attempt in posv_with_recovery."""
+    from ..drivers import auxiliary as _aux
+    from ..drivers import cholesky as _chol
+    from ..drivers.blas3 import gemm
+    from ..types import Norm
+    from . import certify as _certify
+    o = _with(opts, ErrorPolicy=ErrorPolicy.Info)
+    L, fh = _chol.potrf(_round_bf16(A), o)
+    L = _round_bf16(L)
+    X = _chol.potrs(L, B, o)
+    for _ in range(ir_steps):
+        R = gemm(-1.0, A, X, 1.0, B, opts)     # r = B - A X, ORIGINAL A
+        X = _aux.add(1.0, _chol.potrs(L, R, o), 1.0, X)
+    R = gemm(-1.0, A, X, 1.0, B, opts)
+    anorm = _aux.norm(Norm.Fro, A)
+    cert = _certify.certify_solve(anorm, X.to_dense(), B.to_dense(),
+                                  R.to_dense(), iters=ir_steps)
+    return (L, X), _h.merge(fh, cert)
+
+
 def posv_with_recovery(A, B, opts: Options | None = None):
     """posv body with non-HPD fallback (drivers/cholesky.py delegates).
 
     On an eager non-HPD failure with Option.UseFallbackSolver set, retries
     the solve as Hermitian-indefinite (hesv), then as plain LU (gesv).
-    posv is already speculation-shaped — Cholesky (the cheapest factor)
-    first, certified by its own pivots — so Option.Speculate changes
-    nothing here; it reorders hesv (see hesv_with_recovery).
+    posv is already speculation-shaped in f32 — Cholesky (the cheapest
+    factor) first, certified by its own pivots — so ``Option.Speculate``
+    alone changes nothing here.  With ``Option.Precision = bf16`` as well
+    (both resolved ONCE at this boundary, like ErrorPolicy) the ladder
+    grows a rung BELOW f32: factor the bf16-rounded operand, refine in
+    the original system, accept only on the residual certificate
+    (:func:`_chol_bf16_attempt`); the f32 Cholesky attempt is always the
+    first escalation target, so anything posv could solve before it
+    still solves.
     With ``Option.Abft`` an unrepaired checksum detection retries the
-    SAME Cholesky attempt once before the indefinite fallbacks — the
+    SAME attempt once before the indefinite fallbacks — the
     localized-repair-then-retry rung below full escalation (see
     gesv_with_recovery).
 
     The first returned element is the factor object of whichever method
     succeeded (TriangularMatrix / HEFactors / LUFactors)."""
-    first = _chol_attempt(A, B, opts)
-    fallbacks, rungs = [], []
+    speculate = resolve_speculate(opts)   # resolved ONCE, like ErrorPolicy
+    low = resolve_precision(opts)         # the one Option.Precision read
+    bf16 = speculate and low
+    if bf16:
+        first_name = "cholesky_bf16"
+        first = _chol_bf16_attempt(A, B, opts)
+        same = lambda: _chol_bf16_attempt(A, B, opts)      # noqa: E731
+        fallbacks = [lambda: _chol_attempt(A, B, opts)]
+        rungs = ["cholesky"]
+    else:
+        first_name = "cholesky"
+        first = _chol_attempt(A, B, opts)
+        same = lambda: _chol_attempt(A, B, opts)           # noqa: E731
+        fallbacks, rungs = [], []
     if get_option(opts, Option.UseFallbackSolver):
-        fallbacks = [lambda: _hesv_attempt(A, B, opts),
-                     lambda: _gesv_attempt(A, B, opts)]
-        rungs = ["hesv", "gesv"]
+        fallbacks += [lambda: _hesv_attempt(A, B, opts),
+                      lambda: _gesv_attempt(A, B, opts)]
+        rungs += ["hesv", "gesv"]
         if resolve_abft(opts):  # the one Option.Abft read here
-            fallbacks.insert(0, lambda: _chol_attempt(A, B, opts))
+            fallbacks.insert(0, same)
             rungs.insert(0, "retry_same")
     (F, X), h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
                                     max_retries=max(len(fallbacks), 2))
-    _obs.note_path("cholesky", rungs, used, False)
+    _obs.note_path(first_name, rungs, used, bf16)
     return _finalize_solve(
         "posv", F, X, h, opts,
         lambda hh: SlateNotPositiveDefiniteError(
@@ -383,6 +446,59 @@ def hesv_with_recovery(A, B, opts: Options | None = None):
 
 # ------------------------------------------------------------------ gels
 
+def _gels_bf16_attempt(A, B, opts, refine: int = 2):
+    """The speculative gels fast path one precision lower
+    (Option.Speculate + Option.Precision = bf16): Householder QR of the
+    bf16-ROUNDED operand with R itself bf16-rounded — QR rather than
+    CholQR so the low-precision factor error enters the refinement at
+    cond(A), not cond(A)^2 — then Björck CSNE sweeps through R against
+    the ORIGINAL system and the normal-equations certificate at the f32
+    working tolerance.  A failed certificate escalates to the unchanged
+    f32 chain in gels_with_recovery."""
+    import jax.numpy as jnp
+    from jax import lax
+    from ..drivers import auxiliary as _aux
+    from ..drivers import qr as _qr
+    from ..drivers.blas3 import gemm
+    from ..types import Norm
+    from . import certify as _certify
+    from .precision import round_through
+    n = A.n
+    F = _qr.geqrf(_round_bf16(A), opts)
+    rd = round_through(jnp.triu(F.QR.to_dense()[:n, :n]))
+
+    def sne(Rhs):
+        # dx = R^-1 R^-T (A^H rhs): semi-normal equations through the low
+        # factor; the dense triangular solves mirror gels_qr's idiom
+        Z = gemm(1.0, A.conj_transpose(), Rhs, 0.0, None, opts)
+        y = lax.linalg.triangular_solve(rd, Z.to_dense(), left_side=True,
+                                        lower=False, transpose_a=True)
+        return Z.with_dense(lax.linalg.triangular_solve(
+            rd, y, left_side=True, lower=False))
+
+    X = sne(B)
+    for _ in range(refine):
+        R = gemm(-1.0, A, X, 1.0, B, opts)     # r = B - A X, ORIGINAL A
+        X = _aux.add(1.0, sne(R), 1.0, X)
+    R = gemm(-1.0, A, X, 1.0, B, opts)
+    Rn = gemm(1.0, A.conj_transpose(), R, 0.0, None, opts)
+    anorm = _aux.norm(Norm.Fro, A)
+    cert = _certify.certify_lstsq(
+        anorm, X.to_dense(), B.to_dense(), Rn.to_dense(),
+        tol=_certify.tolerance(A.dtype, max(A.m, A.n)))
+    # the normal-equations certificate is a backward-error gate; a
+    # rank-collapsed rounding (huge ||x|| from a tiny R pivot) can pass it
+    # trivially, so fold a conditioning estimate through R's diagonal into
+    # ``growth`` — bounded_retry's growth demotion then escalates it
+    d = jnp.abs(jnp.diagonal(rd))
+    piv = _h.from_pivots(d)._replace(
+        growth=anorm / jnp.maximum(jnp.min(d), jnp.finfo(rd.dtype).tiny))
+    h = _h.merge(piv, _h.merge(
+        _h.from_result(X.storage.data),
+        cert._replace(iters=jnp.asarray(refine, jnp.int32))))
+    return X, h
+
+
 def gels_with_recovery(A, B, opts: Options | None = None):
     """gels (m >= n) body with CholQR2 speculation and QR fallback
     (drivers/qr.py delegates here), unifying the previously ad-hoc
@@ -396,11 +512,26 @@ def gels_with_recovery(A, B, opts: Options | None = None):
     — squaring the condition number lost too much, or the Gram matrix was
     not numerically HPD — escalates to full Householder QR eagerly.
 
+    ``Option.Precision = bf16`` (resolved ONCE here too) adds a rung
+    BELOW that when speculating: the bf16-rounded-QR CSNE attempt
+    (:func:`_gels_bf16_attempt`) runs first and the certified f32
+    CholQR2 rung is always its escalation target, then Householder QR
+    under Option.UseFallbackSolver as before.
+
     Return shape: ``X`` under Raise/Nan, ``(X, HealthInfo)`` under Info."""
     from ..drivers import qr as _qr
     speculate = resolve_speculate(opts)
+    low = resolve_precision(opts)         # the one Option.Precision read
     method = select_gels_method(opts, A.m, A.n)
-    if speculate:
+    fallbacks, rungs = [], []
+    if speculate and low:
+        first_name = "qr_bf16"
+        first = _gels_bf16_attempt(A, B, opts)
+        fallbacks = [lambda: _qr._gels_cholqr_attempt(A, B, opts, refine=1,
+                                                      certify=True)]
+        rungs = ["cholqr2"]
+        exc = _qr._gram_exc("gels")
+    elif speculate:
         first_name = "cholqr2"
         first = _qr._gels_cholqr_attempt(A, B, opts, refine=1, certify=True)
         exc = _qr._gram_exc("gels")
@@ -417,12 +548,12 @@ def gels_with_recovery(A, B, opts: Options | None = None):
         first_name = "qr"
         first = _qr._gels_qr_attempt(A, B, opts)
         exc = _singular_exc("gels")
-    fallbacks = []
     if first_name != "qr" and get_option(opts, Option.UseFallbackSolver):
-        fallbacks = [lambda: _qr._gels_qr_attempt(A, B, opts)]
+        fallbacks += [lambda: _qr._gels_qr_attempt(A, B, opts)]
+        rungs += ["qr"]
     X, h, used = bounded_retry(first, fallbacks, dtype=A.dtype,
-                               max_retries=1)
-    _obs.note_path(first_name, ["qr"] if fallbacks else [], used, speculate)
+                               max_retries=max(len(fallbacks), 1))
+    _obs.note_path(first_name, rungs, used, speculate)
     return _h.finalize("gels", X, h, opts, exc)
 
 

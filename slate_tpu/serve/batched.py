@@ -30,10 +30,24 @@ per-problem size vector via scalar prefetch — each problem computes
 only its own tiles instead of the full identity-padded bucket.  The
 escalation ladder is unchanged: the batched fast-rung health feeds the
 same per-problem ``lax.cond`` (`_vmap_escalate`), whose safe rung is
-the identical per-problem driver attempt.  A plan miss (or non-f32, or
-an option the ragged rung does not implement) falls back to the
-vmapped cores; both routes share one ``fn(a, b, sizes)`` executable
-signature, so routing never costs the warm server a retrace.
+the identical per-problem driver attempt.  A plan miss (or a dtype /
+option the ragged rung does not implement) falls back to the vmapped
+cores; both routes share one ``fn(a, b, sizes)`` executable signature,
+so routing never costs the warm server a retrace.
+
+Precision rung (``Option.Precision = bf16``, or bf16 operands): one
+more rung BELOW the ladders above — factor in bf16 storage with f32
+accumulation (the bf16 batched Pallas kernels when the plan cache
+resolves one under the ``bfloat16`` plan key, a whole-bucket XLA factor
+of the bf16-rounded operand otherwise), refine with one-two f32 IR
+sweeps against the ORIGINAL operands, and accept each problem only on
+an a-posteriori certificate (robust/certify.certify_solve /
+certify_lstsq).  A failed certificate escalates that problem — and only
+that problem — to the f32 route, whose result is computed by the
+UNCHANGED code above and is therefore bit-identical to serving with the
+rung disabled.  Dtypes are canonicalized once at the boundary
+(robust/precision.normalize_dtype); an unsupported dtype raises
+``SlateUnsupportedDtypeError`` instead of quietly taking a slow route.
 """
 
 from __future__ import annotations
@@ -45,10 +59,17 @@ from jax import lax
 from ..core.matrix import HermitianMatrix, Matrix
 from ..core.storage import TileStorage
 from ..options import ErrorPolicy, Option, Options, resolve_abft
+from ..robust import certify as _cert
 from ..robust import health as _h
+from ..robust import precision as _prec
 from ..types import Uplo
 
 _TILE = 128
+
+# dtypes the serving boundary accepts: f32 (both routes), bf16 (the
+# certified precision rung), f64 (vmapped XLA cores only).  Anything
+# else raises SlateUnsupportedDtypeError at the boundary.
+SERVE_DTYPES = ("float32", "bfloat16", "float64")
 
 
 def _tile(n: int) -> int:
@@ -194,25 +215,31 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _ragged_plan(op: str, a: jax.Array, opts: Options | None):
+def _ragged_plan(op: str, a: jax.Array, opts: Options | None,
+                 dtype: str | None = None):
     """The measured routing decision, taken at TRACE time from static
     shape/dtype/plan data: the ragged batched kernel runs only when the
     tune/ plan cache (or a plan_override) hands back a Pallas plan for
     this op's batch kernel at this bucket size — `tune.resolve_plan` is
     the ONLY selection seam (SEAM011), exactly as for the single-shot
-    drivers.  Returns the plan with nb clamped to the bucket, or None
-    for the vmapped-XLA fallback (plan miss, non-f32, or an option the
-    ragged rung does not implement)."""
+    drivers.  ``dtype`` overrides the plan-key dtype (the precision
+    rung factors in bf16 while ``a`` itself stays f32); spellings are
+    canonicalized so a ``jnp.bfloat16`` object and the ``"bfloat16"``
+    string hit the same plan row.  Returns the plan with nb clamped to
+    the bucket, or None for the vmapped-XLA fallback (plan miss, a
+    dtype the Pallas panels don't implement, or an option the ragged
+    rung does not implement)."""
     from .. import tune as _tune
     nb_bucket = int(a.shape[2] if op == "least_squares_solve"
                     else a.shape[1])
-    if str(a.dtype) != "float32":
+    dtype = _prec.normalize_dtype(a.dtype if dtype is None else dtype)
+    if dtype not in (_prec.HIGH, _prec.LOW):
         return None
     if resolve_abft(opts) and op != "chol_solve":
         # only batch_potrf carries the checksum rungs in-batch; the
         # other ops honor Abft through the vmapped driver cores
         return None
-    plan = _tune.resolve_plan(RAGGED_OPS[op], nb_bucket, str(a.dtype))
+    plan = _tune.resolve_plan(RAGGED_OPS[op], nb_bucket, dtype)
     if plan.kernel != "pallas":
         return None
     nb = min(int(plan.nb), nb_bucket)
@@ -318,6 +345,157 @@ RAGGED_CORES = {
     "least_squares_solve": _ragged_lstsq,
 }
 
+# ---------------------------------------------------------- precision rung
+
+
+def _fro_batch(v):
+    """Per-problem Frobenius norms of a [B, m, n] stack, f32."""
+    v = _prec.promote(v)
+    return jnp.sqrt(jnp.sum(v * v, axis=(1, 2)))
+
+
+def _bf16_chol_attempt(a, b, sizes, plan, opts: Options | None):
+    """bf16 Cholesky attempt: factor the demoted bucket (ragged Pallas
+    when ``plan`` keys a bf16 kernel, whole-bucket XLA otherwise), solve
+    + 2 IR sweeps in f32 against the ORIGINAL operands, certify per
+    problem.  Returns ``(x, h)`` with the certificate folded in."""
+    from ..internal import batched as _bk
+    al = _prec.demote(a)
+    if plan is not None:
+        fal, counts = _bk.batch_potrf(al, sizes, nb=plan.nb, bw=plan.bw,
+                                      interpret=_interpret(),
+                                      abft=resolve_abft(opts))
+    else:
+        # bf16 factor storage emulated around the batched XLA factor
+        fal = _prec.demote(lax.linalg.cholesky(_prec.promote(al)))
+        counts = None
+    fa = _prec.promote(fal)
+
+    def solve(rhs):
+        y = lax.linalg.triangular_solve(fa, rhs, left_side=True, lower=True)
+        return lax.linalg.triangular_solve(fa, y, left_side=True,
+                                           lower=True, transpose_a=True)
+
+    x = solve(b)
+    for _ in range(2):                     # f32 IR against the ORIGINAL a
+        x = x + solve(b - a @ x)
+    r = b - a @ x
+    cert = jax.vmap(
+        lambda an, xi, bi, ri: _cert.certify_solve(an, xi, bi, ri, iters=2)
+    )(_fro_batch(a), x, b, r)
+    h1 = _bk.batch_chol_health(fa)
+    if counts is not None:
+        h1 = h1._replace(abft_detected=counts.detected,
+                         abft_corrected=counts.corrected,
+                         abft_site=counts.site)
+    h1 = _h.merge(h1, cert, jax.vmap(_h.from_result)(x))
+    return x, _demote(h1, a.dtype)
+
+
+def _bf16_solve_attempt(a, b, sizes, plan, opts: Options | None):
+    """bf16 LU attempt: ragged NoPiv batch_getrf on the demoted bucket
+    (partial-pivot XLA LU when no bf16 plan resolves), f32 solves + 2 IR
+    sweeps against the original operands, per-problem certificate."""
+    from ..internal import batched as _bk
+    al = _prec.demote(a)
+    if plan is not None:
+        fal = _bk.batch_getrf(al, sizes, nb=plan.nb, bw=plan.bw,
+                              interpret=_interpret())
+        getrs = lambda rhs: _bk.batch_getrs(fal, rhs)  # noqa: E731
+        fh = _bk.batch_lu_health(a, _prec.promote(fal))
+    else:
+        lu, _, perm = lax.linalg.lu(_prec.promote(al))
+        fa = _prec.promote(_prec.demote(lu))   # bf16 factor storage
+
+        def getrs(rhs):
+            pb = jnp.take_along_axis(rhs, perm[:, :, None], axis=1)
+            y = lax.linalg.triangular_solve(fa, pb, left_side=True,
+                                            lower=True, unit_diagonal=True)
+            return lax.linalg.triangular_solve(fa, y, left_side=True,
+                                               lower=False)
+
+        fh = _bk.batch_lu_health(a, fa)
+    x = getrs(b)
+    for _ in range(2):                     # f32 IR against the ORIGINAL a
+        x = x + getrs(b - a @ x)
+    r = b - a @ x
+    cert = jax.vmap(
+        lambda an, xi, bi, ri: _cert.certify_solve(an, xi, bi, ri, iters=2)
+    )(_fro_batch(a), x, b, r)
+    h1 = _h.merge(fh, cert, jax.vmap(_h.from_result)(x))
+    return x, _demote(h1, a.dtype)
+
+
+def _bf16_lstsq_attempt(a, b, sizes, plan, opts: Options | None):
+    """bf16 least-squares attempt: ragged batch_gels on the demoted
+    bucket (whole-bucket XLA QR when no bf16 plan resolves), one
+    corrected-semi-normal-equations sweep through the bf16 R in f32
+    against the original operands, per-problem normal-equations
+    certificate (certify_lstsq)."""
+    from ..internal import batched as _bk
+    nb = a.shape[2]
+    al = _prec.demote(a)
+    if plan is not None:
+        x, packed = _bk.batch_gels(al, b, sizes, nb=plan.nb,
+                                   interpret=_interpret())
+        R = _prec.promote(packed[:, :nb, :nb])
+    else:
+        q, r = lax.linalg.qr(_prec.promote(al), full_matrices=False)
+        R = _prec.promote(_prec.demote(r))     # bf16 factor storage
+        qtb = jnp.matmul(jnp.swapaxes(_prec.promote(_prec.demote(q)), 1, 2),
+                         b)
+        x = lax.linalg.triangular_solve(R, qtb, left_side=True, lower=False)
+    at = jnp.swapaxes(a, 1, 2)
+
+    def csne(rhs):                          # R^T R dx = A^T rhs (Björck)
+        g = at @ rhs
+        z = lax.linalg.triangular_solve(R, g, left_side=True, lower=False,
+                                        transpose_a=True)
+        return lax.linalg.triangular_solve(R, z, left_side=True,
+                                           lower=False)
+
+    for _ in range(2):                      # f32 CSNE against ORIGINAL a
+        x = x + csne(b - a @ x)
+    rn = at @ (b - a @ x)
+    cert = jax.vmap(_cert.certify_lstsq)(_fro_batch(a), x, b, rn)
+    d = jnp.abs(jnp.diagonal(R, axis1=1, axis2=2))
+    # normal-equations certification is a backward-error gate that a
+    # rank-collapsed rounding can pass trivially (huge ||x|| swamps the
+    # denominator); fold a conditioning estimate through R's diagonal
+    # into ``growth`` so health.acceptable escalates those problems
+    piv = jax.vmap(_h.from_pivots)(d)
+    piv = piv._replace(growth=_fro_batch(a) / jnp.maximum(
+        jnp.min(d, axis=1), jnp.finfo(R.dtype).tiny))
+    h1 = _h.merge(piv, cert, jax.vmap(_h.from_result)(x))
+    return x, _demote(h1, a.dtype)
+
+
+BF16_ATTEMPTS = {
+    "solve": _bf16_solve_attempt,
+    "chol_solve": _bf16_chol_attempt,
+    "least_squares_solve": _bf16_lstsq_attempt,
+}
+
+
+def _bf16_rung(op: str, a, b, sizes, opts: Options | None):
+    """The certified precision rung: bf16 fast attempt below the f32
+    ladders.  The f32 route — ragged or vmapped, picked by the SAME plan
+    logic as with the rung disabled — computes every problem's
+    escalation target with unchanged code, so a certificate failure
+    escalates that problem (and only that problem, via the per-problem
+    ``lax.cond``) onto a result bit-identical to the f32-only route.
+    The returned ``escalated`` flags certificate failures: the bench's
+    accept-rate is ``1 - mean(escalated)`` over live slots."""
+    plan_lo = _ragged_plan(op, a, opts, dtype=_prec.LOW)
+    x1, h1 = BF16_ATTEMPTS[op](a, b, sizes, plan_lo, opts)
+    plan32 = _ragged_plan(op, a, opts)
+    if plan32 is not None:
+        x32, h32, _ = RAGGED_CORES[op](a, b, sizes, plan32, opts)
+    else:
+        core = CORES[op]
+        x32, h32, _ = jax.vmap(lambda ai, bi: core(ai, bi, opts))(a, b)
+    return _vmap_escalate(h1, x1, lambda ops: ops, (x32, h32), a.dtype)
+
 
 def make_batched(op: str, opts: Options | None = None):
     """The leading-axis-batched core for one op: ``fn(a, b, sizes)``.
@@ -331,10 +509,25 @@ def make_batched(op: str, opts: Options | None = None):
     ``sizes`` entirely, so both routes share one executable signature
     and the warm server stays retrace-free whichever is picked.  ``opts``
     is closed over as static configuration (it participates in the
-    executable-cache fingerprint, never in the traced data)."""
+    executable-cache fingerprint, never in the traced data).
+
+    ``Option.Precision = bf16`` (resolved ONCE here, the seam contract)
+    inserts the certified bf16 rung below the f32 ladder for f32
+    buckets; bf16 operands take the same rung unconditionally (promoted
+    working copies, results demoted back).  f64 serves on the vmapped
+    XLA cores; any other dtype raises SlateUnsupportedDtypeError at the
+    boundary instead of quietly taking a slow route."""
     core = CORES[op]
+    bf16_rung = _prec.resolve_precision(opts)
 
     def fn(a, b, sizes):
+        dtype = _prec.normalize_dtype(a.dtype, supported=SERVE_DTYPES)
+        low = dtype == _prec.LOW
+        if low:
+            a, b = _prec.promote(a), _prec.promote(b)
+        if low or (bf16_rung and dtype == _prec.HIGH):
+            x, h, esc = _bf16_rung(op, a, b, sizes, opts)
+            return (_prec.demote(x) if low else x), h, esc
         plan = _ragged_plan(op, a, opts)
         if plan is not None:
             return RAGGED_CORES[op](a, b, sizes, plan, opts)

@@ -67,9 +67,13 @@ def geometric_ladder(base: int = DEFAULT_BASE,
 
 def default_ladder(dtype: str = "float32") -> BucketLadder:
     """The serving ladder: tuned rungs for this chip when the plan cache
-    has ``serve_bucket`` entries, else the geometric default."""
+    has ``serve_bucket`` entries, else the geometric default.  Dtype
+    spellings normalize through the one shared helper
+    (robust/precision.normalize_dtype) so ladder lookups and plan-cache
+    keys can never disagree on "bf16" vs "bfloat16"."""
+    from ..robust.precision import normalize_dtype
     from ..tune import serve_buckets
-    tuned = serve_buckets(dtype)
+    tuned = serve_buckets(normalize_dtype(dtype))
     if tuned:
         return BucketLadder(tuple(int(r) for r in tuned), "tuned")
     return geometric_ladder()

@@ -583,10 +583,11 @@ class Server:
         mfu = gbps = None
         if device_ms:
             secs = device_ms * 1e-3
-            # waste-adjusted by construction: LIVE problem flops only
+            # waste-adjusted by construction: LIVE problem flops only,
+            # against the batch dtype's chip peak (f64 reads n/a)
             mfu = _flops.mfu(_flops.serve_flops(
                 op, [(req.a.shape, req.b.shape) for _, req in members]),
-                secs)
+                secs, dtype)
             item = np.dtype(dtype).itemsize
             gbps = _flops.achieved_gbps(
                 float(batch) * (mb * nb + 2 * mb * kb) * item, secs)

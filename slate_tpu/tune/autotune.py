@@ -29,12 +29,16 @@ def _interpret() -> bool:
 
 def candidates(op: str, n: int, dtype: str = "float32") -> list[TilePlan]:
     """The search space for one (op, n, dtype): always the XLA fallback,
-    plus every shape-legal Pallas (nb, bw) pair."""
+    plus every shape-legal Pallas (nb, bw) pair.  The batched ragged
+    panels additionally take bf16 storage (f32 accumulation inside the
+    kernels — internal/pallas_*.py), so those three ops sweep Pallas
+    candidates for bf16 too; every other kernel is f32-only."""
     if op not in OPS:
         raise ValueError(f"unknown op {op!r} (known: {OPS})")
     plans = [TilePlan("xla", min(n, 512), 8)]
-    if dtype != "float32":
-        return plans                  # pallas kernels are f32-only
+    batch = op in ("batch_potrf", "batch_getrf", "batch_geqrf")
+    if dtype != "float32" and not (batch and dtype == "bfloat16"):
+        return plans
     if op in ("potrf_tile", "lu_select"):
         nbs = [n] if n % 128 == 0 and 128 <= n <= 1024 else []
     else:
@@ -48,9 +52,12 @@ def candidates(op: str, n: int, dtype: str = "float32") -> list[TilePlan]:
     return plans
 
 
-def _problem(op: str, plan: TilePlan, n: int):
+def _problem(op: str, plan: TilePlan, n: int, dtype: str = "float32"):
     """Returns (thunk, flops): a zero-arg jitted candidate runner and the
-    nominal flop count it performs."""
+    nominal flop count it performs.  ``dtype`` reaches only the batched
+    ops (the single-shot kernels are f32-only, see candidates()); their
+    XLA fallbacks compute through f32 exactly as the serving route's
+    promote/demote emulation does, so the measurement is honest."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -157,37 +164,43 @@ def _problem(op: str, plan: TilePlan, n: int):
             flops = float((live ** 3).sum()) / 3
         else:
             flops = 2 * float((live ** 3).sum()) / 3
-        aj, sj = jnp.asarray(a), jnp.asarray(sizes)
+        aj = jnp.asarray(a).astype(dtype)
+        sj = jnp.asarray(sizes)
+        f32 = lambda x: x.astype(jnp.float32)             # noqa: E731
         if op == "batch_potrf":
             if pallas:
                 fn = jax.jit(lambda x, s: batched.batch_potrf(
                     x, s, nb=nb, bw=plan.bw, interpret=interp)[0])
             else:
-                fn = jax.jit(lambda x, s: jax.vmap(jnp.linalg.cholesky)(x))
+                fn = jax.jit(lambda x, s: jax.vmap(jnp.linalg.cholesky)(
+                    f32(x)).astype(x.dtype))
         elif op == "batch_getrf":
             if pallas:
                 fn = jax.jit(lambda x, s: batched.batch_getrf(
                     x, s, nb=nb, bw=plan.bw, interpret=interp))
             else:
                 fn = jax.jit(lambda x, s: jax.vmap(
-                    lambda xi: jax.lax.linalg.lu(xi)[0])(x))
+                    lambda xi: jax.lax.linalg.lu(xi)[0])(
+                        f32(x)).astype(x.dtype))
         else:
             if pallas:
                 fn = jax.jit(lambda x, s: batched.batch_geqrf(
                     x, s, nb=nb, interpret=interp)[0])
             else:
                 fn = jax.jit(lambda x, s: jax.vmap(
-                    lambda xi: jnp.linalg.qr(xi, mode="r"))(x))
+                    lambda xi: jnp.linalg.qr(xi, mode="r"))(
+                        f32(x)).astype(x.dtype))
         return (lambda: fn(aj, sj)), flops
 
     raise ValueError(f"unknown op {op!r}")
 
 
-def measure(op: str, plan: TilePlan, n: int, iters: int = 3) -> float:
+def measure(op: str, plan: TilePlan, n: int, iters: int = 3,
+            dtype: str = "float32") -> float:
     """GFLOP/s of one candidate (best of ``iters``, compile excluded)."""
     import jax
 
-    thunk, flops = _problem(op, plan, n)
+    thunk, flops = _problem(op, plan, n, dtype)
     jax.block_until_ready(thunk())               # compile + warm caches
     best = float("inf")
     for _ in range(max(1, iters)):
@@ -200,7 +213,7 @@ def measure(op: str, plan: TilePlan, n: int, iters: int = 3) -> float:
 def sweep(op: str, n: int, dtype: str = "float32", iters: int = 3):
     """Yield (plan, gflops) for every candidate of (op, n, dtype)."""
     for plan in candidates(op, n, dtype):
-        yield plan, measure(op, plan, n, iters=iters)
+        yield plan, measure(op, plan, n, iters=iters, dtype=dtype)
 
 
 def tune_op(op: str, n: int, dtype: str = "float32", iters: int = 3,

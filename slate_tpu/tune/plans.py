@@ -103,7 +103,12 @@ def _empty() -> dict:
 
 
 def plan_key(n: int, dtype: str) -> str:
-    return f"n={int(n)},dtype={dtype}"
+    """Cache entry key; dtype spellings normalize through the one shared
+    helper (robust/precision.normalize_dtype) so "bf16" and "bfloat16"
+    land on the same entry — a typo'd dtype raises instead of silently
+    keying a fresh miss."""
+    from ..robust.precision import normalize_dtype
+    return f"n={int(n)},dtype={normalize_dtype(dtype)}"
 
 
 def _parse_key(key: str) -> tuple[int, str]:
@@ -295,10 +300,12 @@ def resolve_plan(op: str, n: int, dtype: str = "float32") -> TilePlan:
     noted into the open obs event frame (cache hit vs nearest-n
     distance), so production events audit plan usage."""
     from ..obs import events as _obs
+    from ..robust.precision import normalize_dtype
     if op not in OPS and op not in (DIST_LOOKAHEAD_OP, OOC_PANEL_OP):
         raise ValueError(
             f"unknown op {op!r} "
             f"(known: {OPS + (DIST_LOOKAHEAD_OP, OOC_PANEL_OP)})")
+    dtype = normalize_dtype(dtype)
     _warn_removed_env()
     ov = _OVERRIDES.get(op)
     if ov is not None:
@@ -355,6 +362,8 @@ def serve_buckets(dtype: str = "float32") -> tuple[int, ...] | None:
     Each ``serve_bucket`` entry recorded via :func:`record_plan` (op
     ``SERVE_BUCKET_OP``, ``n`` = the bucket edge, kernel/nb/bw ignored)
     contributes one rung; the returned tuple is sorted ascending."""
+    from ..robust.precision import normalize_dtype
+    dtype = normalize_dtype(dtype)
     entries = _cached().get("chips", {}).get(chip_kind(), {}).get(
         SERVE_BUCKET_OP)
     if not entries:

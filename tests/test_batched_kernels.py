@@ -14,9 +14,11 @@ The load-bearing guarantees:
 - the ABFT checksum rungs detect and repair a single injected strike
   THROUGH a batched panel, and the repaired factor matches the clean run.
 
-The kernels are real-f32-only by contract (the serve router gates dtype);
-everything here runs them via ``interpret=True`` so tier-1 covers the
-exact lowering the TPU executes.
+The kernels take real f32, plus bf16 storage with f32 accumulation for
+the certified serving rung (tests/test_precision.py drills the bf16
+numerics; the serve router gates every other dtype); everything here
+runs them via ``interpret=True`` so tier-1 covers the exact lowering
+the TPU executes.
 """
 
 import jax.numpy as jnp

@@ -136,6 +136,50 @@ def test_serve_ragged_emits_both_routes(bench, capsys):
     assert by_metric["serve_ragged_speedup"]["value"] > 0
 
 
+def test_serve_bf16_emits_both_routes_and_accept_rate(bench, capsys):
+    """bench_serve_bf16 pins the precision-rung line contract: raw AND
+    waste-adjusted problems/s for the bf16-rung and f32-only routes, the
+    certificate accept-rate over live slots, and the speedup ratio — six
+    self-emitted lines carrying the bench schema."""
+    bench.bench_serve_bf16(problems=6, nrhs=2, reps=1, bucket=16)
+    by_metric = {ln["metric"]: ln for ln in _lines(capsys)}
+    assert set(by_metric) == {
+        "serve_precision_bf16_problems_per_s",
+        "serve_precision_f32_problems_per_s",
+        "serve_precision_bf16_adjusted_problems_per_s",
+        "serve_precision_f32_adjusted_problems_per_s",
+        "serve_precision_accept_rate_pct",
+        "serve_precision_bf16_speedup"}
+    for route in ("bf16", "f32"):
+        raw = by_metric[f"serve_precision_{route}_problems_per_s"]
+        adj = by_metric[f"serve_precision_{route}_adjusted_problems_per_s"]
+        assert raw["schema"] == "slate-bench-v1" and "chip" in raw
+        assert raw["unit"] == "problems/s" and raw["value"] > 0
+        assert adj["unit"] == "problems/s"
+        assert adj["value"] >= raw["value"]   # adjusted divides by 1-waste
+    accept = by_metric["serve_precision_accept_rate_pct"]
+    assert accept["unit"] == "%" and 0.0 <= accept["value"] <= 100.0
+    # the workload is well-conditioned by construction: the certificate
+    # must accept most problems or the rung is not doing its job
+    assert accept["value"] >= 50.0
+    assert by_metric["serve_precision_bf16_speedup"]["unit"] == "x"
+    assert by_metric["serve_precision_bf16_speedup"]["value"] > 0
+
+
+def test_serve_bf16_skips_clean_under_budget_preemption(bench, capsys):
+    """The new metric must honor the rc=0 contract: preempted by the
+    budget pool, it reports a skipped line instead of dying."""
+    failures = bench._run_isolated(
+        [(bench.bench_serve_bf16,
+          dict(problems=6, nrhs=2, reps=1, bucket=16))], budget_s=1e-6)
+    assert failures == 0
+    lines = _lines(capsys)
+    assert len(lines) == 1
+    assert lines[0]["metric"] == "bench_serve_bf16_skipped"
+    assert lines[0]["skipped"] is True
+    assert lines[0]["schema"] == "slate-bench-v1"
+
+
 def test_serve_survival_emits_survival_metrics(bench, capsys):
     """bench_serve_survival replays a Poisson arrival stream against a
     live background-flush Server and self-emits five lines: throughput,
@@ -172,6 +216,7 @@ def test_step_lists_cover_every_metric(bench):
         assert "bench_posv_abft" in names
         assert "bench_serve_mixed" in names
         assert "bench_serve_ragged" in names
+        assert "bench_serve_bf16" in names
         assert "bench_serve_survival" in names
         assert "bench_potrf_ooc" in names
         assert "bench_checkpoint_overhead" in names

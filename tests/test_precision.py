@@ -1,0 +1,450 @@
+"""Mixed-precision tests (robust/precision.py seam, bf16 batched
+kernels, the speculative dense rungs, and the certified serving rung).
+
+The load-bearing guarantees:
+
+- ``normalize_dtype`` is the ONE spelling authority: object / np.dtype /
+  alias-string forms canonicalize identically everywhere (plan keys,
+  bucket ladders, the serve boundary), and unsupported spellings raise
+  the typed ``SlateUnsupportedDtypeError`` instead of routing silently;
+- the ragged batched Pallas kernels accept bf16 storage and accumulate
+  in f32: the bf16 factor matches the f32 factor of the bf16-rounded
+  operand at bf16-storage tolerance, never at bf16-accumulation blowup;
+- the dense posv/gels speculative rungs (``Option.Speculate`` +
+  ``Option.Precision = bf16``) accept well-conditioned problems on the
+  certificate and escalate adversarial ones onto a result BIT-IDENTICAL
+  to the rung-disabled route;
+- the serving precision rung escalates per problem — an ill-conditioned
+  member and a Wilkinson growth adversary fail their certificates while
+  their batch neighbors ride bf16 — and escalated problems return the
+  f32 route's bits exactly;
+- a warm server with the rung enabled never retraces, on BOTH the
+  vmapped and the ragged Pallas routes (retrace warnings promoted to
+  errors, compiled=False on every warm event).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu import Option, Precision, Speculate, obs, serve, tune
+from slate_tpu.exceptions import SlateUnsupportedDtypeError
+from slate_tpu.internal import batched
+from slate_tpu.robust import precision
+
+BF16_EPS = 2.0 ** -8                       # bf16 storage half-ulp scale
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(18)
+
+
+@pytest.fixture
+def plan_cache(tmp_path, monkeypatch):
+    path = tmp_path / "plans.json"
+    monkeypatch.setenv("SLATE_TUNE_CACHE", str(path))
+    tune.reload()
+    yield path
+    tune.reload()
+
+
+# --------------------------------------------------------- the seam itself
+
+
+def test_normalize_dtype_is_the_one_spelling_authority():
+    want = "bfloat16"
+    for spelling in (jnp.bfloat16, jnp.dtype(jnp.bfloat16), "bfloat16",
+                     "bf16", jnp.zeros((1,), jnp.bfloat16).dtype):
+        assert precision.normalize_dtype(spelling) == want
+    assert precision.normalize_dtype("fp32") == "float32"
+    assert precision.normalize_dtype(np.float64) == "float64"
+    with pytest.raises(SlateUnsupportedDtypeError):
+        precision.normalize_dtype("bfloat61")          # typo, not a route
+    with pytest.raises(SlateUnsupportedDtypeError):
+        precision.normalize_dtype("float16", supported=("float32",
+                                                        "bfloat16"))
+
+
+def test_resolve_precision_is_explicit_opt_in():
+    assert precision.resolve_precision(None) is False
+    assert precision.resolve_precision({}) is False
+    assert precision.resolve_precision(
+        {Option.Precision: Precision.Auto}) is False   # Auto = f32 today
+    assert precision.resolve_precision(
+        {Option.Precision: Precision.Bf16}) is True
+
+
+def test_round_through_models_bf16_storage(rng):
+    x = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+    y = precision.round_through(x)
+    assert y.dtype == x.dtype
+    assert np.allclose(np.asarray(y), np.asarray(x), rtol=BF16_EPS, atol=0)
+    # idempotent, and exact on bf16-representable values (identity pads)
+    assert np.array_equal(np.asarray(precision.round_through(y)),
+                          np.asarray(y))
+    assert np.array_equal(np.asarray(precision.round_through(jnp.eye(8))),
+                          np.eye(8, dtype=np.float32))
+
+
+# ----------------------------------------- bf16 batched kernels (tentpole)
+
+
+def _spd_stack(rng, n, sizes, dtype=np.float32):
+    a = np.zeros((len(sizes), n, n), dtype)
+    for i, s in enumerate(sizes):
+        if s:
+            g = rng.standard_normal((s, s)).astype(dtype)
+            a[i, :s, :s] = g @ g.T + s * np.eye(s, dtype=dtype)
+            idx = np.arange(s, n)
+            a[i, idx, idx] = 1.0
+    return a
+
+
+def test_batch_potrf_bf16_storage_f32_accumulation(rng):
+    """The bf16 ragged Cholesky: bf16 factor in, bf16 factor out, with
+    error at bf16-STORAGE level against the f32 factor of the rounded
+    operand — f32 accumulation inside the panels is what keeps the gap
+    from compounding with n."""
+    n, nb = 32, 16
+    sizes = np.array([24, 32, 16], np.int32)
+    a32 = _spd_stack(rng, n, sizes)
+    al = jnp.asarray(a32).astype(jnp.bfloat16)
+    fa, _ = batched.batch_potrf(al, jnp.asarray(sizes), nb=nb, bw=8,
+                                interpret=True)
+    assert fa.dtype == jnp.bfloat16
+    ref = np.linalg.cholesky(np.asarray(al, np.float64))
+    got = np.tril(np.asarray(fa, np.float64))
+    assert np.allclose(got, ref, rtol=0, atol=8 * BF16_EPS * n)
+    # the solve side promotes: x comes back f32 from a bf16 factor
+    b = jnp.asarray(rng.standard_normal((len(sizes), n, 2)), jnp.float32)
+    y = jax.lax.linalg.triangular_solve(fa.astype(jnp.float32), b,
+                                        left_side=True, lower=True)
+    assert y.dtype == jnp.float32
+
+
+def test_batch_getrf_bf16_roundtrip(rng):
+    """bf16 ragged NoPiv LU factors in bf16 storage; batch_getrs promotes
+    and returns an f32 solution good to IR-seed quality."""
+    n, nb = 32, 16
+    sizes = np.array([32, 24], np.int32)
+    a = np.zeros((2, n, n), np.float32)
+    for i, s in enumerate(sizes):
+        g = rng.standard_normal((s, s)).astype(np.float32)
+        a[i, :s, :s] = g + s * np.eye(s, dtype=np.float32)
+        idx = np.arange(s, n)
+        a[i, idx, idx] = 1.0
+    al = jnp.asarray(a).astype(jnp.bfloat16)
+    fa = batched.batch_getrf(al, jnp.asarray(sizes), nb=nb, bw=8,
+                             interpret=True)
+    assert fa.dtype == jnp.bfloat16
+    b = jnp.asarray(rng.standard_normal((2, n, 2)), jnp.float32)
+    x = batched.batch_getrs(fa, b)
+    assert x.dtype == jnp.float32
+    r = np.asarray(b) - a @ np.asarray(x)
+    denom = np.linalg.norm(a, axis=(1, 2)) * np.linalg.norm(
+        np.asarray(x), axis=(1, 2)) + np.linalg.norm(np.asarray(b),
+                                                     axis=(1, 2))
+    assert np.all(np.linalg.norm(r, axis=(1, 2)) / denom < 8 * BF16_EPS)
+
+
+# ------------------------------------ dense speculative rungs (posv/gels)
+
+
+BF16_SPEC = {Option.Speculate: Speculate.On,
+             Option.Precision: Precision.Bf16}
+
+
+def _spd(rng, n, cond=1.0, dtype=np.float32):
+    u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    vals = np.logspace(0, -np.log10(cond), n) if cond > 1 else np.ones(n)
+    return ((u * vals) @ u.T).astype(dtype)
+
+
+def test_posv_bf16_rung_accepts_well_conditioned(rng):
+    n, nb = 24, 8
+    a = _spd(rng, n, cond=10.0)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    F, X = st.posv(A, B, BF16_SPEC)
+    xd = np.asarray(X.to_dense(), np.float64)
+    r = np.linalg.norm(a @ xd - b) / (
+        np.linalg.norm(a) * np.linalg.norm(xd) + np.linalg.norm(b))
+    # accepted on the certificate: f32-level backward error from a bf16
+    # factor + 2 f32 IR sweeps
+    assert r < 100 * np.finfo(np.float32).eps * n
+
+
+def test_posv_bf16_rung_escalation_bit_identical(rng):
+    """cond ~ 1e7: the bf16 factor cannot seed convergent IR, the
+    certificate fails, and bounded_retry lands on the f32 Cholesky
+    attempt — the same code the rung-disabled route runs first, so the
+    escalated result is bitwise equal to it."""
+    n, nb = 24, 8
+    a = _spd(rng, n, cond=1e7)
+    b = rng.standard_normal((n, 2)).astype(np.float32)
+    A = st.HermitianMatrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    _, X_rung = st.posv(A, B, BF16_SPEC)
+    _, X_plain = st.posv(A, B)
+    assert np.array_equal(np.asarray(X_rung.to_dense()),
+                          np.asarray(X_plain.to_dense()))
+
+
+def _graded(rng, m, n, cond, dtype=np.float32):
+    u, _ = np.linalg.qr(rng.standard_normal((m, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return ((u * np.logspace(0, -np.log10(cond), n)) @ v.T).astype(dtype)
+
+
+def test_gels_bf16_rung_accepts_well_conditioned(rng):
+    m, n, nb = 32, 8, 8
+    a = _graded(rng, m, n, cond=10.0)
+    b = rng.standard_normal((m, 1)).astype(np.float32)
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    X = st.gels(A, B, BF16_SPEC)
+    xd = np.asarray(X.to_dense(), np.float64)[:n]
+    grad = np.linalg.norm(a.T.astype(np.float64)
+                          @ (a.astype(np.float64) @ xd
+                             - b.astype(np.float64)))
+    scale = np.linalg.norm(a) ** 2 * max(np.linalg.norm(xd), 1.0)
+    assert grad / scale < 100 * np.finfo(np.float32).eps * n
+
+
+def test_gels_bf16_rung_escalation_bit_identical():
+    """cond ~ 1e4 pushes the bf16 CSNE contraction rate past 1: the rung
+    cannot certify and escalates onto the CholQR2 attempt — the identical
+    first attempt of the Speculate-only ladder, so the escalated result
+    matches it bit for bit.  The escalation is pinned via the flight
+    recorder, not assumed."""
+    m, n, nb = 32, 8, 8
+    grng = np.random.default_rng(27)       # seed where the cert fails
+    a = _graded(grng, m, n, cond=1e4)
+    b = grng.standard_normal((m, 1)).astype(np.float32)
+    A = st.Matrix.from_numpy(a, nb)
+    B = st.Matrix.from_numpy(b, nb, nb)
+    with obs.recording() as ev_rung:
+        X_rung = st.gels(A, B, BF16_SPEC)
+    with obs.recording() as ev_spec:
+        X_spec = st.gels(A, B, {Option.Speculate: Speculate.On})
+    assert [e["path"] for e in ev_rung
+            if e.get("path")] == ["escalated:cholqr2"]
+    assert [e["path"] for e in ev_spec
+            if e.get("path")] == ["speculated:cholqr2"]
+    assert np.array_equal(np.asarray(X_rung.to_dense()),
+                          np.asarray(X_spec.to_dense()))
+
+
+# --------------------------------------------- the certified serving rung
+
+
+BF16_SERVE = {Option.Precision: Precision.Bf16}
+
+
+def _mk_chol(rng, n, k, cond=1.0):
+    return _spd(rng, n, cond), rng.standard_normal((n, k)).astype(
+        np.float32)
+
+
+def _mk_solve(rng, n, k):
+    a = rng.standard_normal((n, n)).astype(np.float32)
+    a += np.eye(n, dtype=np.float32) * 4
+    return a, rng.standard_normal((n, k)).astype(np.float32)
+
+
+def _wilkinson(n):
+    a = np.tril(-np.ones((n, n), np.float32), -1) + np.eye(n,
+                                                           dtype=np.float32)
+    a[:, -1] = 1.0
+    return a
+
+
+def _workload(rng):
+    """One bucket's worth per op: well-conditioned members plus two
+    adversaries (indices returned) that MUST fail the bf16 certificate."""
+    reqs, adversarial = [], []
+    for _ in range(3):
+        reqs.append(("chol_solve", *_mk_chol(rng, 24, 2)))
+        reqs.append(("solve", *_mk_solve(rng, 24, 2)))
+    adversarial.append(len(reqs))
+    reqs.append(("chol_solve", *_mk_chol(rng, 24, 2, cond=1e6)))
+    adversarial.append(len(reqs))
+    reqs.append(("solve", _wilkinson(24),
+                 rng.standard_normal((24, 2)).astype(np.float32)))
+    return reqs, adversarial
+
+
+def _residual_ok(req, res):
+    op, a, b = req
+    a64, b64 = a.astype(np.float64), b.astype(np.float64)
+    x = np.asarray(res.x, np.float64)
+    r = np.linalg.norm(a64 @ x - b64) / (
+        np.linalg.norm(a64) * np.linalg.norm(x) + np.linalg.norm(b64))
+    return r < 100 * np.finfo(np.float32).eps * a.shape[1]
+
+
+def test_serve_bf16_rung_certifies_and_isolates_escalation(rng):
+    """The serving acceptance drill: with the rung on, every result still
+    meets the f32 certificate; the ill-conditioned member and the
+    Wilkinson growth adversary escalate; their well-conditioned batch
+    neighbors ride bf16 (escalated=False) — per-problem isolation."""
+    reqs, adversarial = _workload(rng)
+    srv = serve.Server(opts=BF16_SERVE, cache=serve.ExecutableCache())
+    results = srv.serve_batch(reqs)
+    assert len(results) == len(reqs)
+    for i, (req, res) in enumerate(zip(reqs, results)):
+        if i not in adversarial:
+            # neighbors converge on bf16 and still meet the f32 cert
+            assert res.health.converged and _residual_ok(req, res)
+    for i in adversarial:
+        assert results[i].escalated, "adversary must fail the certificate"
+    # the ill-conditioned SPD member converges once escalated to f32; the
+    # Wilkinson growth adversary defeats NoPiv LU in f32 too and is
+    # honestly reported unconverged — escalation, not a silent wrong x
+    assert results[adversarial[0]].health.converged
+    assert _residual_ok(reqs[adversarial[0]], results[adversarial[0]])
+    neighbors = [r for i, r in enumerate(results) if i not in adversarial]
+    assert neighbors and not any(r.escalated for r in neighbors)
+
+
+def test_serve_bf16_escalated_results_bit_identical_to_f32_route(rng):
+    """Escalated problems land on the f32 ladder's result computed by the
+    UNCHANGED f32 code — bitwise equal to serving with the rung off."""
+    reqs, adversarial = _workload(rng)
+    rung = serve.Server(opts=BF16_SERVE,
+                        cache=serve.ExecutableCache()).serve_batch(reqs)
+    plain = serve.Server(cache=serve.ExecutableCache()).serve_batch(reqs)
+    for i in adversarial:
+        assert rung[i].escalated
+        assert np.array_equal(np.asarray(rung[i].x),
+                              np.asarray(plain[i].x))
+
+
+def _serve_events(records):
+    return [e for e in records if e.get("kind") == "serve_batch"]
+
+
+def _assert_warm_is_retrace_free(srv, reqs):
+    with obs.recording() as cold:
+        srv.serve_batch(reqs)
+    cold_ev = _serve_events(cold)
+    assert cold_ev and all(e["compiled"] for e in cold_ev)
+    entries0 = srv.cache.stats()["entries"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", obs.SlateRetraceWarning)
+        with obs.recording() as warm:
+            results = srv.serve_batch(reqs)
+    warm_ev = _serve_events(warm)
+    assert len(warm_ev) == len(cold_ev)
+    assert not any(e["compiled"] for e in warm_ev)
+    assert all(e["retraces"] == 0 for e in warm_ev)
+    assert srv.cache.stats()["entries"] == entries0
+    return results
+
+
+def _retrace_workload(rng):
+    """The escalation drill minus the Wilkinson member: a poison request
+    (escalated AND unhealthy) takes the quarantine's solo-retry path,
+    whose second retry is legitimately a cache hit even cold — the
+    zero-retrace drill wants steady serving, so it keeps the escalating
+    but *convergent* ill-conditioned SPD adversary only."""
+    reqs, adversarial = _workload(rng)
+    del reqs[adversarial[1]]
+    return reqs, adversarial[:1]
+
+
+def test_serve_bf16_warm_zero_retrace_vmapped_route(rng):
+    """Rung enabled, no Pallas plans: the bf16 attempt and its f32 ladder
+    share the one fn(a, b, sizes) executable — the warm repeat is all
+    cache hits under warnings-as-errors."""
+    reqs, _ = _retrace_workload(rng)
+    reqs.append(("least_squares_solve",
+                 _graded(rng, 34, 24, cond=10.0),
+                 rng.standard_normal((34, 2)).astype(np.float32)))
+    srv = serve.Server(opts=BF16_SERVE, cache=serve.ExecutableCache())
+    results = _assert_warm_is_retrace_free(srv, reqs)
+    assert len(results) == len(reqs)
+
+
+def test_serve_bf16_warm_zero_retrace_ragged_route(rng, plan_cache):
+    """Rung enabled WITH Pallas plans persisted under both the f32 and
+    bf16 plan keys: the fast rung factors through the bf16 ragged batched
+    kernels, the escalation target through the f32 ones, and the warm
+    server still never retraces."""
+    for op in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+        for dtype in ("float32", "bfloat16"):
+            tune.record_plan(op, 32, dtype, tune.TilePlan("pallas", 16, 8))
+    reqs, adversarial = _retrace_workload(rng)
+    srv = serve.Server(opts=BF16_SERVE, cache=serve.ExecutableCache())
+    results = _assert_warm_is_retrace_free(srv, reqs)
+    for req, res in zip(reqs, results):
+        assert _residual_ok(req, res)
+    for i in adversarial:
+        assert results[i].escalated
+
+
+def test_serve_bf16_operands_take_the_rung_and_demote_back(rng):
+    """bf16 request dtype: served through the rung unconditionally
+    (promoted working copies), results demoted back to bf16."""
+    a, b = _mk_chol(rng, 16, 2)
+    req = ("chol_solve", jnp.asarray(a).astype(jnp.bfloat16),
+           jnp.asarray(b).astype(jnp.bfloat16))
+    srv = serve.Server(cache=serve.ExecutableCache())
+    (res,) = srv.serve_batch([req])
+    assert np.asarray(res.x).dtype == jnp.bfloat16
+    x = np.asarray(res.x, np.float64)
+    r = np.linalg.norm(a @ x - b) / (
+        np.linalg.norm(a) * np.linalg.norm(x) + np.linalg.norm(b))
+    assert r < 100 * BF16_EPS                  # bf16-storage certificate
+
+
+def test_serve_boundary_rejects_unsupported_dtype(rng):
+    """fp16 is deliberately absent until a driver certifies it: the gate
+    is normalize_dtype's typed error, surfaced through the flush-failure
+    wrapper rather than a silent slow-route fallback."""
+    from slate_tpu.exceptions import SlateServeError
+    a = np.eye(8, dtype=np.float16)
+    b = np.ones((8, 1), np.float16)
+    srv = serve.Server(cache=serve.ExecutableCache())
+    with pytest.raises(SlateServeError, match="float16 not supported"):
+        srv.serve_batch([("solve", a, b)])
+
+
+# -------------------------------------------------- dtype-keyed tune plans
+
+
+def test_plan_key_normalizes_spellings():
+    from slate_tpu.tune.plans import plan_key
+    assert plan_key(64, jnp.bfloat16) == plan_key(64, "bf16")
+    assert plan_key(64, "fp32") == plan_key(64, np.float32)
+    with pytest.raises(SlateUnsupportedDtypeError):
+        plan_key(64, "bfloat61")
+
+
+def test_candidates_open_bf16_only_for_batch_ops():
+    from slate_tpu.tune import autotune
+    for op in ("batch_potrf", "batch_getrf", "batch_geqrf"):
+        kinds = {p.kernel for p in autotune.candidates(op, 256, "bfloat16")}
+        assert "pallas" in kinds
+    # single-shot kernels stay f32-only; f64 is XLA-only everywhere
+    assert {p.kernel for p in autotune.candidates("potrf_tile", 256,
+                                                  "bfloat16")} == {"xla"}
+    assert {p.kernel for p in autotune.candidates("batch_potrf", 256,
+                                                  "float64")} == {"xla"}
+
+
+def test_per_dtype_chip_peak_and_override():
+    from slate_tpu.obs import flops
+    with flops.peak_override(1e12):
+        # the override pins EVERY dtype, so bf16 and f32 MFU agree
+        assert flops.mfu(5e11, 1.0, "bfloat16") == pytest.approx(0.5)
+        assert flops.mfu(5e11, 1.0, jnp.float32) == pytest.approx(0.5)
+    # float64 is deliberately absent from the peak table: mfu reads n/a
+    # rather than inventing a peak the MXU does not have
+    assert flops.mfu(5e11, 1.0, "float64") is None

@@ -448,6 +448,7 @@ def seam_skeleton():
 
             def gels_with_recovery(a, opts=None):
                 spec = resolve_speculate(opts)
+                low = resolve_precision(opts)
                 r = bounded_retry(a)
                 return finalize(r)
 
@@ -459,9 +460,16 @@ def seam_skeleton():
 
 
             def posv_with_recovery(a, opts=None):
+                spec = resolve_speculate(opts)
+                low = resolve_precision(opts)
                 ab = resolve_abft(opts)
                 r = bounded_retry(a)
                 return finalize(r)
+            """,
+        "slate_tpu/serve/batched.py": """\
+            def make_batched(op, shape, dtype, batch, opts=None):
+                low = resolve_precision(opts)
+                return op
             """,
         "slate_tpu/drivers/blas3.py": """\
             def gemm(a, b):
@@ -917,6 +925,84 @@ def test_seam012_silent_in_cache_and_via_cache(tmp_path):
         "def run(fn, a):\n"
         "    exe = get_or_compile(fn, a)\n"
         "    return exe(a)\n")
+    assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
+
+
+def test_seam014_fires_on_low_precision_cast_in_driver(tmp_path):
+    """An astype to a literal low-precision spelling inside drivers/
+    bypasses the robust/precision.py seam (and its f32-accumulation
+    contract) and fires SEAM014."""
+    files = seam_skeleton()
+    files["slate_tpu/drivers/qr.py"] = (
+        "from ..robust import health\n"
+        "import jax.numpy as jnp\n\n\n"
+        "def qr(a, opts=None):\n"
+        "    low = a.astype(jnp.bfloat16)\n"
+        "    return health.finalize(low)\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM014"}
+    assert "bfloat16" in fs[0].message
+
+
+def test_seam014_fires_on_dtype_kwarg_in_serve(tmp_path):
+    """A dtype= keyword spelling low precision inside serve/ is the same
+    bypass in allocation form ('bf16' string alias included)."""
+    files = seam_skeleton()
+    files["slate_tpu/serve/server.py"] = (
+        "import jax.numpy as jnp\n\n\n"
+        "def pack(n):\n"
+        "    return jnp.zeros((n, n), dtype='bf16')\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM014"}
+
+
+def test_seam014_fires_on_raw_precision_knob(tmp_path):
+    """Reading Option.Precision outside robust/precision.py (and the enum
+    definition in options.py) fires SEAM014: boundaries consume
+    resolve_precision's boolean, resolved exactly once."""
+    files = seam_skeleton()
+    files["slate_tpu/drivers/hetrf.py"] = (
+        _driver("hetrf") +
+        "\n\ndef peek(a, opts=None):\n"
+        "    return opts.get(Option.Precision)\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM014"}
+
+
+def test_seam014_fires_on_double_resolve_precision(tmp_path):
+    """A precision boundary resolving the knob twice breaks the
+    resolve-exactly-once contract, same as SEAM005/SEAM008."""
+    files = seam_skeleton()
+    files["slate_tpu/serve/batched.py"] = (
+        "def make_batched(op, shape, dtype, batch, opts=None):\n"
+        "    low = resolve_precision(opts)\n"
+        "    low2 = resolve_precision(opts)\n"
+        "    return op\n")
+    fs = lint(mini_repo(tmp_path, files), SEAM_IDS)
+    assert rule_ids(fs) == {"SEAM014"}
+    assert "EXACTLY once" in fs[0].message
+
+
+def test_seam014_silent_on_lax_precision_and_high_casts(tmp_path):
+    """jax's own lax.Precision attribute and high-precision casts
+    (astype(jnp.float32)) must NOT trip the rule — the knob match is
+    exact on the `Option` base name, the cast ban only on low spellings.
+    The precision seam itself (robust/precision.py) may demote freely."""
+    files = seam_skeleton()
+    files["slate_tpu/drivers/qr.py"] = (
+        "from ..robust import health\n"
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n\n\n"
+        "def qr(a, opts=None):\n"
+        "    p = lax.Precision.HIGHEST\n"
+        "    up = a.astype(jnp.float32)\n"
+        "    return health.finalize(up)\n")
+    files["slate_tpu/robust/precision.py"] = (
+        "import jax.numpy as jnp\n\n\n"
+        "def demote(x):\n"
+        "    return x.astype(jnp.bfloat16)\n\n\n"
+        "def resolve_precision(opts):\n"
+        "    return bool(opts and opts.get(Option.Precision))\n")
     assert lint(mini_repo(tmp_path, files), SEAM_IDS) == []
 
 

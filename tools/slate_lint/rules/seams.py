@@ -35,9 +35,21 @@ SEAM013 (new, PR 17) — checkpoint serialization (write_payload /
         inside slate_tpu/robust/checkpoint.py — the on-disk format,
         atomic-rename discipline and verification ladder have ONE blast
         radius; everything else goes through CheckpointManager
+SEAM014 (new, PR 18) — mixed precision is a certified policy, not an
+        ambient cast: (a) no literal low-precision float spelling
+        (bfloat16 / float16 / bf16 / fp16) reaches an astype or dtype=
+        inside drivers/ or serve/ — storage-precision changes go through
+        robust/precision.py (demote / promote / round_through), where
+        the f32-accumulation contract lives; (b) the raw
+        ``Option.Precision`` knob (exact ``Option`` base match, so
+        ``lax.Precision`` never false-positives) is read only inside
+        robust/precision.py and options.py; (c) the precision
+        boundaries (serve/batched.py make_batched, recovery.py
+        posv_with_recovery + gels_with_recovery) call resolve_precision
+        EXACTLY once
 ====== ===============================================================
 
-SEAM011–SEAM013 have no legacy twins (they postdate the migration);
+SEAM011–SEAM014 have no legacy twins (they postdate the migration);
 their ``legacy`` strings are the modern ``path:line: msg`` form.
 """
 
@@ -68,11 +80,12 @@ HEALTH_NAMES = {"finalize", "finalize_flat", "error_policy", "HealthInfo",
 
 SPECULATIVE_BOUNDARIES = (
     ("slate_tpu/robust/recovery.py",
-     ("gesv_with_recovery", "gels_with_recovery", "hesv_with_recovery")),
+     ("gesv_with_recovery", "gels_with_recovery", "hesv_with_recovery",
+      "posv_with_recovery")),
     (f"{DRIVERS_DIR}/mixed.py", ("gesv_mixed",)),
 )
 RECOVERY_BOUNDARIES = {"gesv_with_recovery", "gels_with_recovery",
-                       "hesv_with_recovery"}
+                       "hesv_with_recovery", "posv_with_recovery"}
 RBT_MODULE = "slate_tpu/internal/rbt.py"
 FINALIZE_NAMES = {"finalize", "_finalize_solve"}
 
@@ -92,6 +105,18 @@ CKPT_MODULE = "slate_tpu/robust/checkpoint.py"
 #: so torn-write semantics and the verify ladder have one blast radius
 RAW_CKPT_IO_NAMES = {"write_payload", "read_payload", "write_manifest",
                      "read_manifest"}
+
+PRECISION_MODULE = "slate_tpu/robust/precision.py"
+OPTIONS_MODULE = "slate_tpu/options.py"
+#: literal low-precision float spellings banned in drivers//serve/ casts:
+#: storage-precision changes go through robust/precision.py, which owns
+#: the f32-accumulation contract and the one normalize_dtype vocabulary
+LOW_PRECISION_SPELLINGS = {"bfloat16", "float16", "bf16", "fp16", "half"}
+PRECISION_BOUNDARIES = (
+    ("slate_tpu/serve/batched.py", ("make_batched",)),
+    ("slate_tpu/robust/recovery.py",
+     ("posv_with_recovery", "gels_with_recovery")),
+)
 
 ABFT_MODULE = "slate_tpu/robust/abft.py"
 FAULTS_MODULE = "slate_tpu/robust/faults.py"
@@ -226,6 +251,7 @@ def seam_scan(project) -> list[tuple[str, Finding]]:
     out.extend(_scan_tune(project))
     out.extend(_scan_serve(project))
     out.extend(_scan_checkpoint(project))
+    out.extend(_scan_precision(project))
     project.cache["seam_scan"] = out
     return out
 
@@ -528,6 +554,101 @@ def _scan_checkpoint(project):
                     legacy=f"{rel}:{node.lineno}: {msg}"))
 
 
+def _spells_low_precision(node) -> str | None:
+    """The low-precision spelling a dtype-expression node carries, if any:
+    a string literal ('bfloat16', 'bf16', ...) or a dotted/bare name whose
+    terminal attribute is one (jnp.bfloat16, np.float16, ml_dtypes.bfloat16).
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value.lower() in LOW_PRECISION_SPELLINGS \
+            else None
+    if isinstance(node, ast.Attribute) and node.attr in \
+            LOW_PRECISION_SPELLINGS:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in LOW_PRECISION_SPELLINGS:
+        return node.id
+    return None
+
+
+def _scan_precision(project):
+    # SEAM014a: no literal low-precision cast in drivers/ or serve/ — the
+    # precision seam (robust/precision.py demote/promote/round_through) is
+    # the only place storage precision changes, so the f32-accumulation
+    # contract and the certificate gate cannot be bypassed by a stray
+    # .astype(jnp.bfloat16) that silently degrades results.
+    for rel in _slate_modules(project):
+        if not rel.startswith((DRIVERS_DIR + "/", SERVE_DIR + "/")):
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            exprs = []
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "astype":
+                exprs += node.args[:1]
+            exprs += [kw.value for kw in node.keywords
+                      if kw.arg == "dtype"]
+            for expr in exprs:
+                spelling = _spells_low_precision(expr)
+                if spelling is not None:
+                    msg = (f"casts to low precision (`{spelling}`) inside "
+                           f"drivers//serve/ — storage precision changes "
+                           f"only through robust/precision.py "
+                           f"(demote/promote/round_through), where the "
+                           f"f32-accumulation contract lives")
+                    yield ("SEAM014", Finding(
+                        "SEAM014", rel, node.lineno, msg,
+                        legacy=f"{rel}:{node.lineno}: {msg}"))
+    # SEAM014b: the raw knob is read only inside the seam and its enum
+    # definition.  Exact-match on the `Option` base name so jax's
+    # lax.Precision (and any other Precision attribute) never trips it.
+    for rel in _slate_modules(project):
+        if rel in (PRECISION_MODULE, OPTIONS_MODULE):
+            continue
+        mod = project.modules[rel]
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute)
+                    and node.attr == "Precision"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "Option"):
+                msg = ("reads Option.Precision directly — boundaries "
+                       "consume resolve_precision's boolean (resolved "
+                       "exactly once), never the raw knob")
+                yield ("SEAM014", Finding(
+                    "SEAM014", rel, node.lineno, msg,
+                    legacy=f"{rel}:{node.lineno}: {msg}"))
+    # SEAM014c: precision boundaries resolve the knob exactly once, the
+    # same resolve-exactly-once contract SEAM005/SEAM008 pin for
+    # Speculate and Abft.
+    for rel, fns in PRECISION_BOUNDARIES:
+        mod = project.module(rel)
+        if mod is None:
+            yield ("SEAM014", Finding(
+                "SEAM014", rel, 1, "missing precision boundary module",
+                legacy=f"{rel}: missing precision boundary module"))
+            continue
+        defs = {n.name: n for n in mod.tree.body
+                if isinstance(n, ast.FunctionDef)}
+        for fname in fns:
+            fn = defs.get(fname)
+            if fn is None:
+                yield ("SEAM014", Finding(
+                    "SEAM014", rel, 1,
+                    f"precision boundary `{fname}` not found",
+                    legacy=f"{rel}: precision boundary `{fname}` "
+                           f"not found"))
+                continue
+            n_res = _count_calls(fn, {"resolve_precision"})
+            if n_res != 1:
+                msg = (f"`{fname}` calls resolve_precision {n_res}x — the "
+                       f"knob must be resolved EXACTLY once at the "
+                       f"boundary")
+                yield ("SEAM014", Finding(
+                    "SEAM014", rel, fn.lineno, msg,
+                    legacy=f"{rel}:{fn.lineno}: {msg}"))
+
+
 def legacy_report(project) -> list[str]:
     """The pre-migration checker's report lines, in its order, honoring
     per-line suppressions (the legacy checker predates suppressions, so a
@@ -581,3 +702,7 @@ _make("SEAM013", "checkpoint serialization (write/read payload+manifest) "
       "only inside robust/checkpoint.py — everyone else goes through "
       "CheckpointManager, so the format and verify ladder have one "
       "blast radius")
+_make("SEAM014", "mixed precision is a certified policy: no literal "
+      "low-precision cast in drivers//serve/ (the seam is "
+      "robust/precision.py), the raw Option.Precision knob is read only "
+      "there, and precision boundaries resolve_precision exactly once")
