@@ -850,6 +850,83 @@ def bench_serve_survival(problems, rate_hz, nrhs, sizes, budget_ms):
                       "unit": "bool", "n": problems}), flush=True)
 
 
+def bench_serve_pool(problems, rate_hz, nrhs, sizes, members):
+    """Elastic device pool (robustness PR): the same seeded Poisson
+    mixed-size stream replayed against a 1-member server and a
+    ``members``-wide DevicePool server, with a transient device kill and
+    online retuning live on the pool run.  Reports the pool's admitted
+    problems/s and its scaling over one device (on a single-chip host
+    the members share the device, so ~1.0x is the honest answer — the
+    line exists to price the pool machinery, not to fake speedup), the
+    failover recovery wall (failover record -> the survivor's completed
+    redispatch), and the retune hot-swap count.  Emits its own lines:
+    problems/s, x, ms and a count, not GFLOP/s."""
+    from slate_tpu import obs, serve
+    from slate_tpu.robust import faults as _faults
+
+    def replay(srv, plans=()):
+        work = _faults.poisson_workload(16, problems, rate_hz, sizes,
+                                        nrhs=nrhs)
+        srv.serve_batch([(op, a, b) for _, op, a, b in work])  # warm
+        srv.start()
+        t0 = time.perf_counter()
+        with obs.recording() as events:
+            with _faults.inject(*plans):
+                tickets = []
+                for t_arr, op, a, b in work:
+                    lag = t_arr - (time.perf_counter() - t0)
+                    if lag > 0:
+                        time.sleep(lag)
+                    tickets.append(srv.submit(op, a, b))
+                done = sum(tk.result(timeout=120.0) is not None
+                           for tk in tickets)
+            wall = time.perf_counter() - t0
+            srv.shutdown()
+        return done / max(wall, 1e-9), list(events)
+
+    cfg = dict(max_queue=max(problems, 8),
+               flush_occupancy=max(problems // 8, 4),
+               max_batch_delay_ms=10.0, watchdog_timeout_s=120.0)
+    _PROGRESS["phase"] = "compile"
+    one, _ = replay(serve.Server(
+        cache=serve.ExecutableCache(),
+        admission=serve.AdmissionConfig(**cfg)))
+    _PROGRESS["phase"] = "run"
+    devs = jax.local_devices()
+    devs = (devs * members)[:members] if len(devs) < members \
+        else devs[:members]
+    pool = serve.DevicePool(devs, serve.PoolConfig(strike_limit=1))
+    srv = serve.Server(
+        cache=serve.ExecutableCache(), pool=pool,
+        admission=serve.AdmissionConfig(
+            **cfg, retune_interval_s=0.25, retune_min_samples=32))
+    kill = _faults.FaultPlan("serve_device_fail", transient=True,
+                             device=0)
+    rate, events = replay(srv, plans=(kill,))
+    fo = [e for e in events if e.get("kind") == "serve_device"
+          and e.get("event") == "failover"]
+    recovery = None
+    if fo:
+        after = [e["ts"] for e in events if e.get("kind") == "serve_batch"
+                 and e["ts"] >= fo[0]["ts"]]
+        if after:
+            recovery = round(1e3 * (after[0] - fo[0]["ts"]), 2)
+    swaps = sum(1 for e in events if e.get("kind") == "serve_retune")
+    base = {"schema": BENCH_SCHEMA, "chip": CHIP}
+    print(json.dumps({**base, "metric": "serve_pool_problems_per_s",
+                      "value": round(rate, 2),
+                      "unit": "problems/s", "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_pool_scaling",
+                      "value": round(rate / max(one, 1e-9), 3),
+                      "unit": "x", "n": members}), flush=True)
+    print(json.dumps({**base, "metric": "serve_pool_failover_recovery_ms",
+                      "value": recovery, "unit": "ms",
+                      "n": problems}), flush=True)
+    print(json.dumps({**base, "metric": "serve_pool_retune_swaps",
+                      "value": swaps, "unit": "count",
+                      "n": problems}), flush=True)
+
+
 def bench_potrf_ooc(n, nb, iters):
     """Out-of-core Cholesky throughput (durability PR): the host-resident
     TileMap streaming path — every panel round-trips host<->device with
@@ -947,6 +1024,8 @@ QUICK_STEPS = [
     (bench_serve_bf16, dict(problems=12, nrhs=4, reps=2, bucket=32)),
     (bench_serve_survival, dict(problems=24, rate_hz=400.0, nrhs=4,
                                 sizes=(24, 48), budget_ms=5000.0)),
+    (bench_serve_pool, dict(problems=24, rate_hz=400.0, nrhs=4,
+                            sizes=(40, 96), members=2)),
     (bench_potrf_ooc, dict(n=192, nb=64, iters=2)),
     (bench_checkpoint_overhead, dict(n=192, nb=64, iters=2)),
 ]
@@ -974,6 +1053,8 @@ FULL_STEPS = [
     (bench_serve_bf16, dict(problems=48, nrhs=16, reps=3, bucket=256)),
     (bench_serve_survival, dict(problems=192, rate_hz=800.0, nrhs=16,
                                 sizes=(48, 96, 160), budget_ms=2000.0)),
+    (bench_serve_pool, dict(problems=192, rate_hz=800.0, nrhs=16,
+                            sizes=(96, 160, 320), members=4)),
     (bench_potrf_ooc, dict(n=4096, nb=512, iters=3)),
     (bench_checkpoint_overhead, dict(n=4096, nb=512, iters=3)),
 ]

@@ -38,11 +38,12 @@ _LOWER_BETTER = ("waste", "overhead", "latency", "_ms", "compile",
 #: metric-name substrings with wider run-to-run noise (percent); first
 #: match wins, so survival (timing-sensitive shed/quarantine rates under
 #: a live flush loop) and precision (the bf16-rung bench times two full
-#: Server routes back to back, doubling the timing jitter surface)
+#: Server routes back to back, doubling the timing jitter surface) and
+#: pool (live failover/retune drills riding the same flush loop)
 #: outrank the generic serve band
 _NOISY = (("survival", 20.0), ("durability", 20.0), ("precision", 20.0),
-          ("serve", 15.0), ("sweep", 10.0), ("batch", 10.0),
-          ("lookahead", 10.0))
+          ("pool", 20.0), ("serve", 15.0), ("sweep", 10.0),
+          ("batch", 10.0), ("lookahead", 10.0))
 
 
 def direction(metric: str, unit: str | None = None) -> str:

@@ -337,7 +337,9 @@ def emit_serve_shed(payload: dict) -> None:
     ``serve_shed``; serve/server.py is the only caller).  The payload
     carries op/dtype, the shed ``reason`` (deadline / overflow_* /
     watchdog / shutdown), the victim's age and the queue depth — the
-    inputs behind the serving table's ``shed/1k`` column."""
+    inputs behind the serving table's ``shed/1k`` column.  ``device_id``
+    is always None: shedding happens at admission, before the device
+    pool picks a member."""
     if not _active():
         return
     _emit({"schema": SCHEMA, "kind": "serve_shed", "ts": time.time(),
@@ -347,10 +349,37 @@ def emit_serve_shed(payload: dict) -> None:
 def emit_serve_quarantine(payload: dict) -> None:
     """One record per request quarantined to the singleton slow path
     after exhausting the fresh-batch retry (kind ``serve_quarantine``;
-    serve/server.py is the only caller) — the ``quar/1k`` column."""
+    serve/server.py is the only caller) — the ``quar/1k`` column.
+    ``device_id`` is the pool member that served the singleton."""
     if not _active():
         return
     _emit({"schema": SCHEMA, "kind": "serve_quarantine", "ts": time.time(),
+           **payload})
+
+
+def emit_serve_device(payload: dict) -> None:
+    """One record per device-pool health transition (kind
+    ``serve_device``; serve/pool.py is the only caller).  The payload
+    carries ``event`` (failover / quarantine / probe_fail / readmit),
+    the pool member's ``device_id``, the triggering ``reason``
+    (exception / nonfinite / deadline / canary / flake) and the strike
+    count — the inputs behind the serving table's ``failovers``
+    column and the kill-a-device drill's assertions."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": "serve_device", "ts": time.time(),
+           **payload})
+
+
+def emit_serve_retune(payload: dict) -> None:
+    """One record per online ladder hot-swap (kind ``serve_retune``;
+    serve/server.py is the only caller).  The payload carries the
+    op/dtype whose ladder was refit, the old and new rungs, the live
+    vs fitted padded-waste ratios that justified the swap, and how
+    many observed sizes fed the DP fitter — the ``retunes`` column."""
+    if not _active():
+        return
+    _emit({"schema": SCHEMA, "kind": "serve_retune", "ts": time.time(),
            **payload})
 
 

@@ -111,7 +111,8 @@ def split_records(records):
         elif schema == EVENT_SCHEMA and kind == "span":
             spans.append(r)
         elif schema == EVENT_SCHEMA and kind in (
-                "serve_batch", "serve_shed", "serve_quarantine"):
+                "serve_batch", "serve_shed", "serve_quarantine",
+                "serve_device", "serve_retune"):
             serve.append(r)
         elif schema == EVENT_SCHEMA and kind in (
                 "checkpoint_save", "checkpoint_restore"):
@@ -233,23 +234,42 @@ def summarize_serve(serve) -> dict:
     Survival records ride the same stream: ``serve_shed`` records count
     into ``shed`` / ``shed_per_1k`` (per 1k offered = served + shed)
     and ``serve_quarantine`` into ``quarantined`` / ``quar_per_1k``
-    (per 1k served problems)."""
+    (per 1k served problems).
+
+    Device-pool records ride it too: ``dev`` counts the distinct pool
+    members that served a row's batches, ``failovers`` sums the
+    redispatches its batches survived (``serve_batch.failovers``, so
+    nothing double-counts the pool's own ``serve_device`` records), and
+    ``serve_retune`` hot-swaps land on their own ``ladder/<dtype>``
+    row's ``retunes`` column."""
     table: dict[str, dict] = {}
-    for e in serve:
-        key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
-        s = table.setdefault(key, {
+
+    def row(key):
+        return table.setdefault(key, {
             "batches": 0, "problems": 0, "escalated": 0, "compiles": 0,
-            "retraces": 0, "shed": 0, "quarantined": 0,
-            "_occ": [], "_waste": [], "_dur_ms": 0.0,
-            "_lat": [], "_age": [], "_mfu": []})
+            "retraces": 0, "shed": 0, "quarantined": 0, "failovers": 0,
+            "retunes": 0, "_occ": [], "_waste": [], "_dur_ms": 0.0,
+            "_lat": [], "_age": [], "_mfu": [], "_devs": set()})
+
+    for e in serve:
         kind = e.get("kind")
+        if kind == "serve_device":
+            continue        # pool lifecycle, not serving work
+        key = f"{e.get('op') or '?'}/{e.get('dtype') or '?'}"
+        s = row(key)
         if kind == "serve_shed":
             s["shed"] += 1
             continue
         if kind == "serve_quarantine":
             s["quarantined"] += 1
             continue
+        if kind == "serve_retune":
+            s["retunes"] += 1
+            continue
         s["batches"] += 1
+        s["failovers"] += int(e.get("failovers") or 0)
+        if e.get("device_id") is not None:
+            s["_devs"].add(int(e["device_id"]))
         s["problems"] += int(e.get("problems") or 0)
         s["escalated"] += int(e.get("escalated") or 0)
         s["compiles"] += 1 if e.get("compiled") else 0
@@ -273,6 +293,7 @@ def summarize_serve(serve) -> dict:
         occ, waste = s.pop("_occ"), s.pop("_waste")
         lat, age, mfus = s.pop("_lat"), s.pop("_age"), s.pop("_mfu")
         dur_s = s.pop("_dur_ms") / 1e3
+        s["dev"] = len(s.pop("_devs"))
         s["occupancy_p50"] = percentile(occ, 50)
         s["occupancy_p99"] = percentile(occ, 99)
         s["padding_waste_p50"] = percentile(waste, 50)
@@ -392,12 +413,14 @@ def render(summary: dict) -> str:
                  s.get("latency_p50_ms"), s.get("latency_p99_ms"),
                  s.get("mfu"), s.get("wa_pps"), s["esc_per_1k"],
                  s.get("shed_per_1k"), s.get("quar_per_1k"),
+                 s.get("dev"), s.get("failovers"), s.get("retunes"),
                  s["retraces"], s["compiles"]]
                 for key, s in summary["serve"].items()]
         parts.append("\nserving\n" + _table(
             ["op/dtype", "batches", "problems", "occ_p50", "occ_p99",
              "waste_p50", "lat_p50_ms", "lat_p99_ms", "mfu", "wa_pps",
-             "esc/1k", "shed/1k", "quar/1k", "retraces", "compiles"],
+             "esc/1k", "shed/1k", "quar/1k", "dev", "failovers",
+             "retunes", "retraces", "compiles"],
             rows))
     if summary.get("checkpoint"):
         rows = [[key, s["count"], s["bytes"], s["wall_p50_ms"],

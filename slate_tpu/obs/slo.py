@@ -68,47 +68,112 @@ class LatencyGovernor:
     that sheds deadline-doomed requests at admission instead of wasting
     a batch slot).  With no budget declared the governor never reports
     overload; with no observations yet it estimates zero wait —
-    admission stays permissive until there is data to act on."""
+    admission stays permissive until there is data to act on.
+
+    Per-device tails (the PR 19 device pool): ``observe(lat, device=i)``
+    additionally files the sample under pool member ``i``, so
+    :meth:`p99_ms` / :meth:`overloaded` answer for one device and
+    :meth:`overload_fraction` reports WHICH SHARE of the pool is over
+    budget.  Backpressure then scales with the sick fraction — one slow
+    device out of four tightens admission by an eighth, not by half —
+    so the pool's survivors keep serving at capacity while the governor
+    and the pool's own quarantine machinery isolate the sick member.
+    Union-only streams (no device ids observed) keep the pre-pool
+    semantics: overload means the whole world is slow, fraction 1."""
 
     def __init__(self, budget_ms: float | None = None, window: int = 64):
         self.budget_ms = budget_ms
+        self._window = max(int(window), 1)
         self._lock = threading.Lock()
-        self._lat: deque = deque(maxlen=max(int(window), 1))
+        self._lat: deque = deque(maxlen=self._window)
+        self._dev_lat: dict = {}       # device id -> deque of latencies
 
-    def observe(self, latency_ms: float) -> None:
-        """Record one delivered request's submit->result latency."""
+    def observe(self, latency_ms: float, device: int | None = None) -> None:
+        """Record one delivered request's submit->result latency,
+        optionally filed under the pool member that served it."""
         with self._lock:
             self._lat.append(float(latency_ms))
+            if device is not None:
+                dq = self._dev_lat.get(device)
+                if dq is None:
+                    dq = self._dev_lat[device] = deque(
+                        maxlen=self._window)
+                dq.append(float(latency_ms))
 
-    def p99_ms(self) -> float | None:
+    def _samples(self, device: int | None) -> list:
         with self._lock:
-            vals = list(self._lat)
-        return _metrics.percentile(vals, 99)
+            if device is None:
+                return list(self._lat)
+            return list(self._dev_lat.get(device, ()))
+
+    def p99_ms(self, device: int | None = None) -> float | None:
+        return _metrics.percentile(self._samples(device), 99)
+
+    def device_p99s(self) -> dict:
+        """Rolling p99 per observed pool member (the flight recorder's
+        per-device tail view)."""
+        with self._lock:
+            devs = {d: list(dq) for d, dq in self._dev_lat.items()}
+        return {d: _metrics.percentile(vals, 99)
+                for d, vals in sorted(devs.items())}
 
     def estimate_wait_ms(self) -> float:
         """Expected admission->result wait (rolling p50; 0 cold)."""
-        with self._lock:
-            vals = list(self._lat)
-        return _metrics.percentile(vals, 50) or 0.0
+        return _metrics.percentile(self._samples(None), 50) or 0.0
 
-    def overloaded(self) -> bool:
-        """Is the rolling p99 over the declared budget?  The admission
-        queue halves its effective capacity while this holds."""
+    def overloaded(self, device: int | None = None) -> bool:
+        """Is the rolling p99 (of one device, or the union) over the
+        declared budget?  Admission capacity tightens while this holds."""
         if self.budget_ms is None:
             return False
-        p99 = self.p99_ms()
+        p99 = self.p99_ms(device)
         return p99 is not None and p99 > self.budget_ms
+
+    def overload_fraction(self) -> float:
+        """The share of the pool that is over budget, in [0, 1].
+
+        With per-device observations: overloaded devices / observed
+        devices.  Without (union-only stream): 1.0 when the union p99
+        is over budget, else 0.0 — the pre-pool halving behavior.
+        Admission control scales its capacity by ``1 - fraction/2``."""
+        if self.budget_ms is None:
+            return 0.0
+        with self._lock:
+            devs = list(self._dev_lat)
+        if not devs:
+            return 1.0 if self.overloaded() else 0.0
+        over = sum(1 for d in devs if self.overloaded(d))
+        return over / len(devs)
 
 
 def aggregate(records) -> dict:
     """Per-``op/dtype`` serving stats plus an ``"*"`` union row, from
-    any mixed record list (non-serve records are ignored)."""
+    any mixed record list (non-serve records are ignored).
+
+    Batches stamped with a ``device_id`` (the device pool) additionally
+    aggregate into ``device:<id>`` rows, so a budgets file can declare
+    per-device latency targets — ``{"device:0": {"latency_p99_ms":
+    250}}`` — and a single slow pool member fails its own row instead
+    of hiding inside the union tail."""
     serve = _metrics.split_records(records)[2]
     table = _metrics.summarize_serve(serve)
     if serve:
         union = _metrics.summarize_serve(
             [{**e, "op": "*", "dtype": "all"} for e in serve])
         table["*"] = next(iter(union.values()))
+    by_dev: dict = {}
+    for e in serve:
+        dev = e.get("device_id")
+        # serve_device (pool lifecycle) records also carry device_id but
+        # summarize to nothing — a member that only got quarantined must
+        # not produce an empty row
+        if isinstance(dev, int) and e.get("kind") == "serve_batch":
+            by_dev.setdefault(dev, []).append(
+                {**e, "op": "device", "dtype": str(dev)})
+    for dev, evs in sorted(by_dev.items()):
+        row = _metrics.summarize_serve(evs)
+        if row:
+            table[f"device:{dev}"] = next(iter(row.values()))
     return table
 
 

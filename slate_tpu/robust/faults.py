@@ -84,8 +84,25 @@ SITES = ("input", "post_panel", "post_collective", "solve",
 #: ``serve_cache_evict``    the executable cache drops every entry at
 #:                          the next lookup (mid-flight eviction: the
 #:                          recompile path under load)
+#: ``serve_device_fail``    one pool member's dispatch fails: kind
+#:                          ``nan`` poisons the batch output (the
+#:                          non-finite sentinel path), any other kind
+#:                          raises at dispatch (the exception sentinel
+#:                          path).  ``FaultPlan(device=i)`` confines the
+#:                          strike to pool member ``i``; transient plans
+#:                          kill the device once, persistent plans keep
+#:                          it dead until the plan deactivates (the
+#:                          canary probes it back in)
+#: ``serve_device_slow``    one pool member sleeps ``delay_s`` around a
+#:                          dispatch — past the pool's per-dispatch
+#:                          deadline this reads as a wedged device and
+#:                          the batch fails over to a survivor
+#: ``serve_canary_flake``   the quarantine canary probe fails (the sick
+#:                          device is still sick): readmission is
+#:                          refused and the next probe is rescheduled
 SERVE_SITES = ("serve_flush_delay", "serve_compile_stall",
-               "serve_cache_evict")
+               "serve_cache_evict", "serve_device_fail",
+               "serve_device_slow", "serve_canary_flake")
 #: HOST-side durability chaos sites (docs/ROBUSTNESS.md "Durable jobs"):
 #: consumed via :func:`host_fire` by robust/checkpoint.py and the
 #: out-of-core tile map in core/storage.py —
@@ -129,6 +146,9 @@ class FaultPlan:
     nb: int = 0
     # host-side serving sites only: how long the chaos sleep lasts
     delay_s: float = 0.0
+    # host-side device-pool sites only: confine the strike to one pool
+    # member index (None = any member that reaches the site first)
+    device: int | None = None
 
     def __post_init__(self):
         if self.site not in SITES and self.site not in HOST_SITES:
@@ -142,6 +162,10 @@ class FaultPlan:
                     or any(int(t) != t or t < 0 for t in self.tile)):
                 raise ValueError(f"tile must be two non-negative block "
                                  f"indices, got {self.tile!r}")
+        if self.device is not None and (int(self.device) != self.device
+                                        or self.device < 0):
+            raise ValueError(f"device must be a non-negative pool member "
+                             f"index, got {self.device!r}")
 
 
 _ACTIVE: dict[str, FaultPlan] = {}
@@ -180,7 +204,7 @@ def active(site: str) -> FaultPlan | None:
     return _ACTIVE.get(site)
 
 
-def host_fire(site: str) -> FaultPlan | None:
+def host_fire(site: str, device: int | None = None) -> FaultPlan | None:
     """Consume an active HOST-side chaos plan at ``site``.
 
     Unlike :func:`maybe_corrupt` this never touches a trace: the serving
@@ -188,11 +212,18 @@ def host_fire(site: str) -> FaultPlan | None:
     the executable cache, the checkpoint writer, the tile-map copy path)
     and act on the returned plan (sleep, evict, tear a write).  Transient
     plans fire at most once per :func:`inject` activation — one stalled
-    compile or one torn checkpoint, not a permanently broken disk."""
+    compile or one torn checkpoint, not a permanently broken disk.
+
+    ``device`` is the calling pool member's index (serve/pool.py): a
+    plan declaring ``FaultPlan(device=i)`` fires only when member ``i``
+    reaches the site — a miss neither fires nor consumes, so a transient
+    kill-device-1 plan cannot be eaten by member 0 passing by first."""
     if site not in HOST_SITES:
         return None
     plan = _ACTIVE.get(site)
     if plan is None:
+        return None
+    if plan.device is not None and plan.device != device:
         return None
     if plan.transient:
         epoch = _PLAN_EPOCH.get(site, 0)

@@ -16,7 +16,12 @@ cores, with
   typed overflow policies (:mod:`admission`),
 - a ``Server`` front end emitting one obs record per batch, with an
   optional background flush loop, wedge watchdog, and poison-request
-  quarantine — the survival layer of docs/SERVING.md (:mod:`server`).
+  quarantine — the survival layer of docs/SERVING.md (:mod:`server`),
+- an elastic :class:`DevicePool` that round-robins batches across the
+  node's accelerators, fails the SAME packed batch over to a survivor
+  when a member dies (zero lost tickets, bit-identical results),
+  quarantines sick members and readmits them after a clean canary
+  probe (:mod:`pool` — docs/SERVING.md "Device pool").
 """
 
 from .admission import (OVERFLOW_POLICIES, AdmissionConfig, AdmissionQueue,
@@ -28,11 +33,13 @@ from .bucket import (BucketLadder, default_ladder, geometric_ladder,
                      least_squares_buckets, next_pow2, pad_rows, pad_square,
                      pad_tall, solve_buckets)
 from .cache import ExecutableCache, default_cache, options_fingerprint
+from .pool import DevicePool, PoolConfig, PoolMember
 from .server import SERVE_OPS, Request, Result, Server
 
 __all__ = [
     "AdmissionConfig", "AdmissionQueue", "BucketLadder", "CORES",
-    "ExecutableCache", "OVERFLOW_POLICIES", "Request", "Result",
+    "DevicePool", "ExecutableCache", "OVERFLOW_POLICIES", "PoolConfig",
+    "PoolMember", "Request", "Result",
     "SERVE_OPS", "Server", "SlateServeError", "SlateServeOverloadError",
     "SlateServeTimeoutError", "Ticket", "chol_solve_core", "default_cache",
     "default_ladder", "geometric_ladder", "least_squares_buckets",

@@ -65,7 +65,14 @@ class AdmissionConfig:
     are pending, or when the oldest has waited this long);
     ``watchdog_timeout_s`` is how long one flush may run before the
     watchdog declares it wedged; ``slo_budget_ms`` / ``slo_window``
-    parameterize the live latency governor (None = no backpressure)."""
+    parameterize the live latency governor (None = no backpressure).
+
+    Online ladder retuning (docs/TUNING.md "Hot-swap"):
+    ``retune_interval_s`` paces the background refit tick (None = off);
+    ``retune_min_samples`` is how many observed sizes the DP fitter
+    needs before it argues; ``retune_margin`` is the padding-waste
+    improvement a fitted ladder must show before the server hot-swaps
+    it (hysteresis — a marginal win is not worth recompiling)."""
 
     max_queue: int = 256
     overflow: str = "reject"
@@ -76,6 +83,9 @@ class AdmissionConfig:
     watchdog_timeout_s: float = 30.0
     slo_budget_ms: float | None = None
     slo_window: int = 64
+    retune_interval_s: float | None = None
+    retune_min_samples: int = 64
+    retune_margin: float = 0.05
 
     def __post_init__(self):
         if self.overflow not in OVERFLOW_POLICIES:
@@ -86,6 +96,11 @@ class AdmissionConfig:
             raise ValueError("admission: max_queue must be >= 1")
         if self.flush_occupancy < 1:
             raise ValueError("admission: flush_occupancy must be >= 1")
+        if (self.retune_interval_s is not None
+                and self.retune_interval_s <= 0):
+            raise ValueError("admission: retune_interval_s must be > 0")
+        if self.retune_min_samples < 1:
+            raise ValueError("admission: retune_min_samples must be >= 1")
 
 
 class Ticket(int):
@@ -179,11 +194,16 @@ class AdmissionQueue:
     # --------------------------------------------------------- admission
 
     def capacity(self) -> int:
-        """Effective capacity right now: ``max_queue``, halved while the
-        governor reports the latency SLO blown (backpressure)."""
+        """Effective capacity right now: ``max_queue`` scaled by the
+        governor's overloaded share of the device pool — ``1 - frac/2``
+        (backpressure).  A union-only stream (no per-device samples)
+        reports fraction 1 when over budget, so the pre-pool behavior
+        — halve the world — is the single-device special case; one
+        slow member out of four only trims capacity by an eighth."""
         cap = self.config.max_queue
-        if self.governor.overloaded():
-            cap = max(1, cap // 2)
+        frac = self.governor.overload_fraction()
+        if frac > 0.0:
+            cap = max(1, int(cap * (1.0 - frac / 2.0)))
         return cap
 
     def offer(self, build, deadline: float | None, now: float):

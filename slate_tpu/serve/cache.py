@@ -73,7 +73,8 @@ class ExecutableCache:
             self._compile_ms = 0.0
 
     def get_or_compile(self, op: str, bucket_shape: tuple, dtype,
-                       batch: int, opts: Options | None = None):
+                       batch: int, opts: Options | None = None,
+                       device=None):
         """The compiled batch executable for one bucket, compiling on
         first use.  Returns ``(executable, hit)``.
 
@@ -85,10 +86,19 @@ class ExecutableCache:
         donating ``b``.  ``sizes`` carries per-problem live sizes as
         TRACED data — the ragged kernels consume it via scalar
         prefetch, the vmapped fallback ignores it — so mixed-size
-        batches never alter the executable's static signature."""
+        batches never alter the executable's static signature.
+
+        ``device`` pins the executable to one accelerator (the device
+        pool compiles the same jaxpr once per member; input specs carry
+        a SingleDeviceSharding so dispatch needs no transfer fallback).
+        Distinct devices are distinct cache keys, but two pool members
+        backed by the SAME physical device (the CPU drill harness)
+        share one entry."""
         dtype = str(jax.numpy.dtype(dtype))
+        devkey = (None if device is None
+                  else (device.platform, int(device.id)))
         key = (op, tuple(int(s) for s in bucket_shape), dtype,
-               options_fingerprint(opts), int(batch))
+               options_fingerprint(opts), int(batch), devkey)
         # chaos site: a mid-flight eviction forces the recompile path —
         # the serving layer must survive losing its warm executables
         if _faults.host_fire("serve_cache_evict") is not None:
@@ -107,7 +117,7 @@ class ExecutableCache:
         if stall is not None:
             time.sleep(stall.delay_s)
         t0 = time.perf_counter()
-        exe = self._compile(op, key[1], dtype, int(batch), opts)
+        exe = self._compile(op, key[1], dtype, int(batch), opts, device)
         dt_ms = (time.perf_counter() - t0) * 1e3
         with self._lock:
             winner = self._exes.setdefault(key, exe)
@@ -117,15 +127,20 @@ class ExecutableCache:
 
     @staticmethod
     def _compile(op: str, bucket_shape: tuple, dtype: str, batch: int,
-                 opts: Options | None):
+                 opts: Options | None, device=None):
         if len(bucket_shape) == 3:
             mb, nb, kb = bucket_shape
         else:
             nb, kb = bucket_shape
             mb = nb
-        a_spec = jax.ShapeDtypeStruct((batch, mb, nb), dtype)
-        b_spec = jax.ShapeDtypeStruct((batch, mb, kb), dtype)
-        s_spec = jax.ShapeDtypeStruct((batch,), "int32")
+        sharding = (None if device is None
+                    else jax.sharding.SingleDeviceSharding(device))
+        a_spec = jax.ShapeDtypeStruct((batch, mb, nb), dtype,
+                                      sharding=sharding)
+        b_spec = jax.ShapeDtypeStruct((batch, mb, kb), dtype,
+                                      sharding=sharding)
+        s_spec = jax.ShapeDtypeStruct((batch,), "int32",
+                                      sharding=sharding)
         fn = _batched.make_batched(op, opts)
         # donate b only where the result aliases it exactly: a square
         # solve's x has b's shape, least squares returns (nb, kb) != b

@@ -1,0 +1,423 @@
+"""Elastic device pool: multi-device dispatch that survives losing one.
+
+The survival layer (admission.py / server.py) made the front door
+robust against overload, wedged flushes and poison requests — but every
+batch still ran on the process default device, so one sick chip took
+the whole tier down.  This module makes placement and recovery the
+LIBRARY's job (SLATE's premise, scaled to the node): a
+:class:`DevicePool` owns one :class:`PoolMember` per accelerator,
+round-robins flushed batches across the healthy ones, and runs the
+failover ladder when a member misbehaves:
+
+1. **detect** — a dispatch that raises, returns non-finite results for
+   problems whose ``HealthInfo`` claims health (the device lied — a
+   real device-loss signature, distinct from a poison request whose
+   health honestly reports failure), or exceeds the per-dispatch
+   deadline derived from the :class:`~slate_tpu.obs.slo.
+   LatencyGovernor`'s rolling tail (a wedged device: the dispatch
+   thread lingers, the pool moves on — tickets are first-write-wins,
+   so a zombie result that limps home later is dropped, not
+   double-delivered).
+2. **fail over** — the SAME packed batch is redispatched onto the next
+   healthy member.  The packed host buffers are untouched by a failed
+   attempt (each attempt ``device_put``'s fresh device arrays, so B's
+   donation never consumes the host copy), and every member runs the
+   same executable compiled from the same jaxpr — results after
+   failover are bit-identical to a no-fault run and zero tickets are
+   lost.
+3. **quarantine** — ``strike_limit`` consecutive failures retire the
+   member from rotation (one transient blip heals itself: any success
+   resets the counter).
+4. **canary & readmit** — every ``canary_interval_s`` the pool probes
+   a quarantined member with a small canary solve; a clean probe
+   readmits it, a failed probe (or a ``serve_canary_flake`` chaos
+   plan) reschedules the next one.
+
+Degraded modes: with one healthy member left the pool keeps serving
+single-device (``degraded()`` is True — what a load balancer scrapes);
+with none it raises a loud typed
+:class:`~slate_tpu.exceptions.SlateServeOverloadError` — callers'
+tickets carry the error, nothing is silently dropped.  Probes run
+BEFORE member selection, so a pool in total blackout readmits a
+recovered device instead of staying dark forever.
+
+Chaos sites (robust/faults.py ``SERVE_SITES``, deterministic on CPU):
+``serve_device_fail`` (kind ``nan`` poisons the batch output so the
+non-finite sentinel must catch it; any other kind raises at dispatch),
+``serve_device_slow`` (sleeps past the dispatch deadline — the wedged
+path), ``serve_canary_flake`` (the probe fails).  All three honor
+``FaultPlan(device=i)`` targeting.
+
+Per-device truth: every failover / quarantine / readmission / probe
+emits a ``serve_device`` obs record (obs/events.py) and the governor
+files delivered latencies per member, so backpressure tightens by the
+POOL's sick fraction (``LatencyGovernor.overload_fraction``) instead
+of halving the world.
+
+Thread safety: all member state (strikes, quarantine, rotation cursor,
+counters) is guarded by ``_lock``, declared in the slate-lint LockSpec
+registry.  Dispatch and compilation never run under it — CON003's
+compile-under-lock class is the bug this layer must not have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import jax
+import numpy as np
+
+from ..exceptions import SlateServeError, SlateServeOverloadError
+from ..obs import events as _events
+from ..obs import slo as _slo
+from ..robust import faults as _faults
+
+#: member lifecycle states
+HEALTHY, QUARANTINED = "healthy", "quarantined"
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolConfig:
+    """Failure-detection knobs (docs/SERVING.md "Device pool").
+
+    ``strike_limit`` consecutive dispatch failures quarantine a member;
+    ``dispatch_timeout_s`` is the per-dispatch deadline (None derives
+    it live from the governor: ``max(dispatch_floor_s,
+    dispatch_factor * rolling p99)`` — and no deadline at all while the
+    governor has no latency budget, so default sync serving never pays
+    a watcher thread); ``canary_interval_s`` paces readmission probes
+    of quarantined members; ``canary_n`` is the canary solve's size."""
+
+    strike_limit: int = 2
+    dispatch_timeout_s: float | None = None
+    dispatch_floor_s: float = 10.0
+    dispatch_factor: float = 8.0
+    canary_interval_s: float = 0.25
+    canary_n: int = 8
+
+    def __post_init__(self):
+        if self.strike_limit < 1:
+            raise ValueError("pool: strike_limit must be >= 1")
+        if self.canary_interval_s <= 0:
+            raise ValueError("pool: canary_interval_s must be > 0")
+
+
+class PoolMember:
+    """One accelerator in the pool: the device handle plus the mutable
+    health bookkeeping (mutated only under the owning pool's lock)."""
+
+    __slots__ = ("index", "device", "state", "strikes", "dispatches",
+                 "failures", "next_probe", "quarantined_at")
+
+    def __init__(self, index: int, device):
+        self.index = index
+        self.device = device
+        self.state = HEALTHY
+        self.strikes = 0
+        self.dispatches = 0
+        self.failures = 0
+        self.next_probe = 0.0
+        self.quarantined_at: float | None = None
+
+    def describe(self) -> dict:
+        return {"index": self.index, "device": str(self.device),
+                "state": self.state, "strikes": self.strikes,
+                "dispatches": self.dispatches, "failures": self.failures}
+
+
+class _DeviceFailure(Exception):
+    """Internal dispatch-failure sentinel: why one member's attempt was
+    declared dead (``exception`` / ``nonfinite`` / ``deadline``)."""
+
+    def __init__(self, reason: str, cause: BaseException | None = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.cause = cause
+
+
+def _poison_tree(out):
+    """The ``serve_device_fail kind='nan'`` payload: every inexact leaf
+    of the dispatch result becomes NaN — finite-typed leaves (health
+    flags, escalation bits) keep claiming success, which is exactly the
+    lie the non-finite sentinel exists to catch."""
+    def leaf(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.inexact):
+            return np.full_like(a, np.nan)
+        return x
+    return jax.tree_util.tree_map(leaf, out)
+
+
+class DevicePool:
+    """Round-robin dispatcher over the node's healthy accelerators.
+
+    ``devices`` defaults to ``jax.local_devices()``; tests pass an
+    explicit list (duplicating the CPU device gives a K-member pool on
+    one chip — the kill-a-device drill's harness).  ``governor`` is the
+    shared :class:`~slate_tpu.obs.slo.LatencyGovernor` the per-dispatch
+    deadline derives from; ``canary`` is the probe callable
+    ``(member) -> bool`` (the Server wires a real canary solve through
+    its executable cache; standalone pools readmit on the chaos-gated
+    default)."""
+
+    def __init__(self, devices=None, config: PoolConfig | None = None,
+                 governor: _slo.LatencyGovernor | None = None,
+                 canary=None):
+        devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        if not devices:
+            raise ValueError("pool: need at least one device")
+        self.config = config or PoolConfig()
+        self.governor = governor if governor is not None \
+            else _slo.LatencyGovernor()
+        self._canary = canary
+        self._lock = threading.Lock()
+        self._members = [PoolMember(i, d) for i, d in enumerate(devices)]
+        self._rr = 0
+        self._failovers = 0
+        self._quarantines = 0
+        self._readmissions = 0
+
+    # ------------------------------------------------------------ queries
+
+    def size(self) -> int:
+        # slate-lint: disable=CON001 -- the member list is built once in __init__ and never reassigned or resized; only per-member fields mutate (under the lock), so its length is immutable
+        return len(self._members)
+
+    def members(self) -> list:
+        """Snapshot descriptions of every member (for health scrapes)."""
+        with self._lock:
+            return [m.describe() for m in self._members]
+
+    def healthy_devices(self) -> list:
+        """(index, device) of every in-rotation member, rotation order."""
+        with self._lock:
+            return [(m.index, m.device) for m in self._members
+                    if m.state == HEALTHY]
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for m in self._members if m.state == HEALTHY)
+
+    def degraded(self) -> bool:
+        """One survivor (or fewer) in a multi-device pool — serving
+        continues but the next strike is an outage."""
+        return self.size() > 1 and self.healthy_count() <= 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            healthy = sum(1 for m in self._members
+                          if m.state == HEALTHY)
+            return {"devices": len(self._members), "healthy": healthy,
+                    "failovers": self._failovers,
+                    "quarantines": self._quarantines,
+                    "readmissions": self._readmissions}
+
+    def set_canary(self, canary) -> None:
+        """Install the readmission probe (Server does this once at
+        construction; last write wins)."""
+        self._canary = canary
+
+    # ----------------------------------------------------------- deadline
+
+    def dispatch_timeout_s(self) -> float | None:
+        """The per-dispatch deadline: the configured override, else
+        derived from the governor's rolling p99 (None — direct, no
+        watcher thread — while no latency budget is declared)."""
+        cfg = self.config
+        if cfg.dispatch_timeout_s is not None:
+            return cfg.dispatch_timeout_s
+        if self.governor.budget_ms is None:
+            return None
+        p99 = self.governor.p99_ms()
+        derived = (p99 or 0.0) * cfg.dispatch_factor / 1e3
+        return max(cfg.dispatch_floor_s, derived)
+
+    # ----------------------------------------------------------- dispatch
+
+    def dispatch(self, run, validate=None, op: str | None = None,
+                 dtype: str | None = None):
+        """Run one packed batch on the pool; returns ``(result,
+        device_index, failovers)``.
+
+        ``run(member)`` executes the batch on ``member.device`` and
+        returns the materialized host result; ``validate(result)``
+        (optional) returns False when the result smells like device
+        garbage — non-finite output in a slot whose health claims
+        success.  Failures strike the member and the SAME batch fails
+        over to the next healthy one; when every member has been tried
+        (or the pool is fully quarantined and every probe failed) a
+        :class:`SlateServeOverloadError` is raised — the flush path
+        stickies it onto every affected ticket."""
+        self._probe_due()
+        tried: set = set()
+        failovers = 0
+        while True:
+            member = self._select(tried)
+            if member is None:
+                raise SlateServeOverloadError(
+                    f"serve: no healthy device left in the pool "
+                    f"({self.size()} member(s), all quarantined or "
+                    f"already failed this batch) — retrying after a "
+                    f"clean canary probe", policy="pool_exhausted")
+            try:
+                out = self._attempt(run, member)
+                if validate is not None and not validate(out):
+                    raise _DeviceFailure("nonfinite")
+            except _DeviceFailure as f:
+                self._strike(member, f.reason, op, dtype)
+                tried.add(member.index)
+                failovers += 1
+                continue
+            except Exception as e:      # an exception IS the sentinel
+                self._strike(member, "exception", op, dtype, e)
+                tried.add(member.index)
+                failovers += 1
+                continue
+            with self._lock:
+                member.strikes = 0      # consecutive counter: success heals
+                member.dispatches += 1
+            return out, member.index, failovers
+
+    def _attempt(self, run, member: PoolMember):
+        """One member's try, under the per-dispatch deadline.  The
+        chaos sites live INSIDE the worker so a ``serve_device_slow``
+        sleep is what the deadline watches, exactly like a real hang."""
+        timeout = self.dispatch_timeout_s()
+
+        def work():
+            slow = _faults.host_fire("serve_device_slow",
+                                     device=member.index)
+            if slow is not None:
+                time.sleep(slow.delay_s)
+            fail = _faults.host_fire("serve_device_fail",
+                                     device=member.index)
+            if fail is not None and fail.kind != "nan":
+                raise SlateServeError(
+                    f"chaos: device {member.index} lost at dispatch")
+            out = run(member)
+            if fail is not None:        # kind == "nan": the device lies
+                out = _poison_tree(out)
+            return out
+
+        if timeout is None:
+            return work()
+        box: dict = {}
+        done = threading.Event()
+
+        def _worker():
+            try:
+                box["value"] = work()
+            except BaseException as e:  # delivered to the waiter below
+                box["error"] = e
+            done.set()
+
+        t = threading.Thread(target=_worker, daemon=True,
+                             name=f"slate-serve-dispatch-{member.index}")
+        t.start()
+        if not done.wait(timeout):
+            # wedged: the zombie thread may still finish, but its result
+            # is dropped here and its tickets are settled by the
+            # survivor — first-write-wins makes the late answer a no-op
+            raise _DeviceFailure("deadline")
+        err = box.get("error")
+        if err is not None:
+            raise err
+        return box["value"]
+
+    def _select(self, tried: set) -> PoolMember | None:
+        """Next healthy member in rotation not yet tried this batch."""
+        with self._lock:
+            n = len(self._members)
+            for off in range(n):
+                m = self._members[(self._rr + off) % n]
+                if m.state == HEALTHY and m.index not in tried:
+                    self._rr = (m.index + 1) % n
+                    return m
+        return None
+
+    def _strike(self, member: PoolMember, reason: str, op, dtype,
+                cause: BaseException | None = None) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            member.strikes += 1
+            member.failures += 1
+            self._failovers += 1
+            quarantine = (member.state == HEALTHY
+                          and member.strikes >= self.config.strike_limit)
+            if quarantine:
+                member.state = QUARANTINED
+                member.quarantined_at = now
+                member.next_probe = now + self.config.canary_interval_s
+                self._quarantines += 1
+            strikes = member.strikes
+        _events.emit_serve_device({
+            "event": "failover", "device_id": member.index,
+            "op": op, "dtype": dtype, "reason": reason,
+            "strikes": strikes,
+            "cause": None if cause is None else repr(cause),
+        })
+        if quarantine:
+            _events.emit_serve_device({
+                "event": "quarantine", "device_id": member.index,
+                "op": op, "dtype": dtype, "reason": reason,
+                "strikes": strikes,
+            })
+
+    # ------------------------------------------------------------- canary
+
+    def _probe_due(self) -> None:
+        """Probe every quarantined member whose canary is due; a clean
+        probe readmits it into rotation."""
+        now = time.perf_counter()
+        with self._lock:
+            due = [m for m in self._members
+                   if m.state == QUARANTINED and now >= m.next_probe]
+        for m in due:
+            self._probe(m)
+
+    def probe(self, index: int) -> bool:
+        """Force one member's canary probe now (tests and operators);
+        returns True when the member is (back) in rotation."""
+        with self._lock:
+            member = self._members[index]
+            if member.state == HEALTHY:
+                return True
+        return self._probe(member)
+
+    def _probe(self, member: PoolMember) -> bool:
+        ok = False
+        flake = _faults.host_fire("serve_canary_flake",
+                                  device=member.index)
+        if flake is None:
+            try:
+                ok = True if self._canary is None \
+                    else bool(self._canary(member))
+            except Exception:
+                ok = False
+        now = time.perf_counter()
+        if not ok:
+            with self._lock:
+                member.next_probe = now + self.config.canary_interval_s
+            _events.emit_serve_device({
+                "event": "probe_fail", "device_id": member.index,
+                "op": None, "dtype": None,
+                "reason": "flake" if flake is not None else "canary",
+            })
+            return False
+        with self._lock:
+            quarantined_ms = (
+                None if member.quarantined_at is None
+                else round((now - member.quarantined_at) * 1e3, 3))
+            member.state = HEALTHY
+            member.strikes = 0
+            member.quarantined_at = None
+            self._readmissions += 1
+        _events.emit_serve_device({
+            "event": "readmit", "device_id": member.index,
+            "op": None, "dtype": None, "reason": "canary_ok",
+            "quarantined_ms": quarantined_ms,
+        })
+        return True

@@ -61,6 +61,7 @@ from __future__ import annotations
 
 import threading
 import time
+from concurrent import futures
 from typing import NamedTuple
 
 import jax
@@ -77,6 +78,7 @@ from ..robust.health import HealthInfo
 from . import admission as _admission
 from . import bucket as _bucket
 from . import cache as _cache
+from . import pool as _pool
 
 SERVE_OPS = ("solve", "chol_solve", "least_squares_solve")
 
@@ -128,18 +130,35 @@ class Server:
     process-wide cache); ``admission`` configures the survival layer
     (default :class:`AdmissionConfig`: effectively the old unbounded
     synchronous behavior — queue of 256, no deadlines, no loop until
-    :meth:`start`); ``governor`` injects a shared latency governor."""
+    :meth:`start`); ``governor`` injects a shared latency governor.
+
+    ``pool`` / ``devices`` configure the elastic device pool
+    (serve/pool.py): pass ``devices=jax.local_devices()`` (or a
+    prebuilt :class:`~slate_tpu.serve.pool.DevicePool`) to round-robin
+    flushed batches across the node's accelerators with automatic
+    failover / quarantine / canary readmission.  The DEFAULT is a
+    single-member pool on the process default device — placement,
+    executable-cache accounting and the retrace-free warm contract are
+    identical to the pre-pool server unless the caller opts into more
+    members."""
 
     def __init__(self, opts: Options | None = None,
                  ladder: _bucket.BucketLadder | None = None,
                  cache: _cache.ExecutableCache | None = None,
                  admission: _admission.AdmissionConfig | None = None,
-                 governor=None):
+                 governor=None, pool: _pool.DevicePool | None = None,
+                 devices=None):
         self.opts = dict(opts or {})
         self._ladder = ladder
         self.cache = cache if cache is not None else _cache.default_cache()
         self.admission = admission or _admission.AdmissionConfig()
         self.queue = _admission.AdmissionQueue(self.admission, governor)
+        if pool is None:
+            members = (list(devices) if devices is not None
+                       else [jax.local_devices()[0]])
+            pool = _pool.DevicePool(members, governor=self.queue.governor)
+        self.pool = pool
+        self.pool.set_canary(self._canary_probe)
         # flush/watchdog/lifecycle state shared between the submitting
         # threads, the flush loop and the watchdog; the registry
         # declares _lock's guards (rules/concurrency.py)
@@ -152,13 +171,45 @@ class Server:
         self._flusher: threading.Thread | None = None
         self._watchdog: threading.Thread | None = None
         self._stop_event = threading.Event()        # self-synchronized
+        # online-retune state: per-dtype hot-swapped ladders, the
+        # observed square-size history feeding the DP fitter, and the
+        # swap counter the metrics serving table reports
+        self._ladders: dict = {}           # dtype -> hot-swapped ladder
+        self._sizes: dict = {}             # dtype -> observed n history
+        self._retunes = 0
+        self._retuning = False
+        self._last_retune = time.perf_counter()
 
     # ------------------------------------------------------------ intake
 
     def ladder(self, dtype) -> _bucket.BucketLadder:
+        dtype = str(jnp.dtype(dtype))
+        with self._lock:
+            swapped = self._ladders.get(dtype)
+        if swapped is not None:            # online retune hot-swap wins
+            return swapped
         if self._ladder is not None:
             return self._ladder
-        return _bucket.default_ladder(str(jnp.dtype(dtype)))
+        return _bucket.default_ladder(dtype)
+
+    def _canary_probe(self, member) -> bool:
+        """The pool's readmission probe: one tiny well-conditioned solve
+        through this member's cached executable; True iff the result is
+        finite and healthy.  Runs the same code path a real batch takes,
+        so a device that only fails under dispatch stays quarantined."""
+        n = self.pool.config.canary_n
+        a = np.eye(n, dtype="float32") * 2.0
+        b = np.ones((n, 1), dtype="float32")
+        exe, _ = self.cache.get_or_compile("solve", (n, 1), "float32", 1,
+                                           self.opts,
+                                           device=member.device)
+        a_d, b_d, s_d = jax.device_put(
+            (a[None], b[None], np.array([n], np.int32)), member.device)
+        x, h, _ = exe(a_d, b_d, s_d)
+        x = np.asarray(x)
+        return bool(np.isfinite(x).all()
+                    and np.asarray(h.ok).all()
+                    and np.allclose(x[0], 0.5, atol=1e-4))
 
     def submit(self, op: str, a, b,
                deadline_ms: float | None = None) -> _admission.Ticket:
@@ -231,6 +282,7 @@ class Server:
             "op": op, "dtype": dtype, "reason": reason,
             "age_ms": round(age_ms, 3),
             "queue_depth": self.queue.depth(),
+            "device_id": None,   # shed at admission: no member involved
         })
 
     # ------------------------------------------------- background loop
@@ -268,14 +320,19 @@ class Server:
             wedged = self._wedged
             inflight = len(self._inflight)
             quarantined = self._quarantined
+            retunes = self._retunes
         return {
             "queue": self.queue.stats(),
             "inflight": inflight,
             "running": self.running(),
             "wedged": None if wedged is None else str(wedged),
             "quarantined": quarantined,
+            "retunes": retunes,
+            "pool": self.pool.stats(),
+            "degraded": self.pool.degraded(),
             "slo_p99_ms": self.queue.governor.p99_ms(),
             "slo_budget_ms": self.queue.governor.budget_ms,
+            "slo_device_p99_ms": self.queue.governor.device_p99s(),
         }
 
     def shutdown(self, drain: bool = True,
@@ -320,6 +377,7 @@ class Server:
     def _flush_loop(self) -> None:
         poll_s = max(self.admission.max_batch_delay_ms / 2e3, 1e-3)
         while not self._stop_event.is_set():
+            self._retune_tick()
             if self.queue.flush_due():
                 self._flush_once()
             else:
@@ -396,6 +454,113 @@ class Server:
             self._emit_shed(r.op, str(r.a.dtype), "deadline",
                             (now - r.t_submit) * 1e3)
 
+    # ---------------------------------------------------- online retune
+
+    def _note_sizes(self, dtype: str, entries) -> None:
+        """Record observed problem shapes — ``(op, n, kb)`` triples —
+        into the live histogram the background retune fits against.
+        No-op unless online retuning is enabled (retune_interval_s)."""
+        if self.admission.retune_interval_s is None:
+            return
+        with self._lock:
+            hist = self._sizes.setdefault(dtype, [])
+            hist.extend(entries)
+            if len(hist) > 4096:           # a window, not forever
+                del hist[:len(hist) - 4096]
+
+    def _retune_tick(self) -> None:
+        """Flush-loop tick: kick one background retune worker when the
+        interval has elapsed.  The fit and the executable warming run
+        OFF the flush loop; only the final ladder swap takes the lock."""
+        interval = self.admission.retune_interval_s
+        if interval is None:
+            return
+        now = time.perf_counter()
+        with self._lock:
+            if self._retuning or now - self._last_retune < interval:
+                return
+            self._retuning = True
+            self._last_retune = now
+        threading.Thread(target=self._retune_worker,
+                         name="slate-serve-retune", daemon=True).start()
+
+    def _retune_worker(self) -> None:
+        try:
+            with self._lock:
+                due = [d for d, h in self._sizes.items()
+                       if len(h) >= self.admission.retune_min_samples]
+            for dtype in due:
+                try:
+                    self.retune_now(dtype)
+                except Exception:      # best-effort: never kill serving
+                    pass
+        finally:
+            with self._lock:
+                self._retuning = False
+
+    def retune_now(self, dtype: str) -> dict | None:
+        """Refit the bucket ladder for ``dtype`` from the live size
+        histogram (PR 11's padded-area-optimal DP fitter) and hot-swap
+        it when the fitted ladder beats the live one by at least
+        ``retune_margin`` padding waste.  Returns the swap info dict
+        (also emitted as a ``serve_retune`` obs record), or None when
+        nothing swapped (too few samples, or no win worth the churn).
+
+        The swap is atomic under the server lock: batches already
+        bucketed keep their plan and settle on the old executables; the
+        next flush buckets on the fitted ladder.  The fitted rungs'
+        executables warm on every healthy pool member BEFORE the swap
+        (off the flush loop when driven by the background tick), so the
+        first post-swap flush is a cache hit, not a compile stall.  The
+        histogram resets after a swap — the next fit argues from fresh
+        evidence instead of re-litigating the sizes it already served."""
+        from ..tune import autotune as _autotune
+        dtype = str(jnp.dtype(dtype))
+        cfg = self.admission
+        with self._lock:
+            entries = list(self._sizes.get(dtype, ()))
+        if len(entries) < cfg.retune_min_samples:
+            return None
+        ns = [n for _, n, _ in entries]
+        live = self.ladder(dtype)
+        fitted = _bucket.BucketLadder(
+            _autotune.serve_ladder_from_sizes(ns), "retuned")
+        w_live = _autotune.ladder_waste(ns, live)
+        w_fit = _autotune.ladder_waste(ns, fitted)
+        if w_fit >= w_live - cfg.retune_margin:
+            return None
+        self._warm_rungs(fitted, dtype, entries)
+        with self._lock:
+            self._ladders[dtype] = fitted
+            self._sizes[dtype] = []
+            self._retunes += 1
+        info = {"op": "ladder", "dtype": dtype,
+                "old": [int(r) for r in live.rungs],
+                "new": [int(r) for r in fitted.rungs],
+                "waste_live": round(w_live, 4),
+                "waste_fitted": round(w_fit, 4),
+                "samples": len(entries)}
+        _events.emit_serve_retune(info)
+        return info
+
+    def _warm_rungs(self, ladder, dtype: str, entries) -> None:
+        """Best-effort pre-compile of the fitted ladder's hottest
+        buckets on every healthy pool member — the old executables keep
+        serving while these compile; a warm failure is ignored (the
+        flush path compiles on demand)."""
+        from collections import Counter
+        shapes = Counter((op, ladder.bucket_for(n), kb)
+                         for op, n, kb in entries
+                         if op != "least_squares_solve")
+        batch = _bucket.next_pow2(self.admission.flush_occupancy)
+        for (op, nb, kb), _ in shapes.most_common(4):
+            for _, dev in self.pool.healthy_devices():
+                try:
+                    self.cache.get_or_compile(op, (nb, kb), dtype, batch,
+                                              self.opts, device=dev)
+                except Exception:
+                    return
+
     # ------------------------------------------------------------- drain
 
     def _bucket_of(self, req: Request):
@@ -440,11 +605,12 @@ class Server:
         results: list = [None] * len(pending)
         first_err: Exception | None = None
 
-        def deliver(idx: int, res: Result) -> None:
+        def deliver(idx: int, res: Result,
+                    device: int | None = None) -> None:
             results[idx] = res
             req = pending[idx]
             self.queue.governor.observe(
-                (time.perf_counter() - req.t_submit) * 1e3)
+                (time.perf_counter() - req.t_submit) * 1e3, device)
             if req.ticket is not None:
                 req.ticket.deliver(res)
 
@@ -456,23 +622,42 @@ class Server:
             for idx, req in members_by_idx:
                 key = (req.op, str(req.a.dtype), self._bucket_of(req))
                 groups.setdefault(key, []).append((idx, req))
-            poisons = []
-            for key in sorted(groups, key=repr):
-                op, dtype, shape = key
+            keys = sorted(groups, key=repr)
+
+            def attempt(key):
                 try:
-                    out = self._run_group(op, dtype, shape, groups[key],
-                                          t_flush, queue_depth)
+                    return key, self._run_group(*key, groups[key],
+                                                t_flush, queue_depth), None
                 except Exception as e:
-                    err = e if isinstance(e, SlateServeError) else \
+                    return key, None, e
+
+            workers = min(len(keys), self.pool.healthy_count())
+            if workers > 1:
+                # distinct buckets dispatch CONCURRENTLY: the pool
+                # round-robins them onto different members, so a
+                # multi-device node has several batches in flight at
+                # once instead of serializing behind one chip
+                with futures.ThreadPoolExecutor(
+                        workers, "slate-serve-group") as ex:
+                    outcomes = list(ex.map(attempt, keys))
+            else:
+                outcomes = [attempt(k) for k in keys]
+
+            poisons = []
+            for key, ran, exc in outcomes:
+                op, dtype, shape = key
+                if exc is not None:
+                    err = exc if isinstance(exc, SlateServeError) else \
                         SlateServeError(
                             f"serve: flush failed for {op}/{dtype} "
-                            f"bucket {shape}: {e}")
-                    err.__cause__ = e if err is not e else None
+                            f"bucket {shape}: {exc}")
+                    err.__cause__ = exc if err is not exc else None
                     first_err = first_err or err
                     for idx, req in groups[key]:
                         if req.ticket is not None:
                             req.ticket.fail(err)
                     continue
+                out, device = ran
                 for idx, res in out:
                     req = reqs[idx]
                     if _poison(req, res):
@@ -481,7 +666,7 @@ class Server:
                         poisons.append((idx, req._replace(
                             retries=req.retries + 1)))
                     else:
-                        deliver(idx, res)
+                        deliver(idx, res, device)
             return poisons
 
         poisons = run_pass(list(enumerate(pending)), len(pending))
@@ -502,8 +687,8 @@ class Server:
         op, dtype, shape = key
         t0 = time.perf_counter()
         try:
-            ((_, res),) = self._run_group(op, dtype, shape, [(idx, req)],
-                                          t_flush, 1)
+            ((_, res),), device = self._run_group(
+                op, dtype, shape, [(idx, req)], t_flush, 1)
         except Exception as e:
             err = e if isinstance(e, SlateServeError) else \
                 SlateServeError(f"serve: quarantine slow path failed for "
@@ -517,11 +702,15 @@ class Server:
             "retries": max(req.retries - 1, 0),   # fresh-batch retries spent
             "ok": bool(res.health.ok),
             "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "device_id": device,
         })
-        deliver(idx, res)
+        deliver(idx, res, device)
 
     def _run_group(self, op: str, dtype: str, shape: tuple, members,
                    t_flush: float, queue_depth: int):
+        """Pack, dispatch through the device pool, unpack one group.
+        Returns ``(out, device_index)`` with ``out`` the per-member
+        ``(idx, Result)`` list."""
         t0 = time.perf_counter()
         n_real = len(members)
         batch = _bucket.next_pow2(n_real)
@@ -549,23 +738,57 @@ class Server:
             real_elems += m_i * n_i + m_i * req.b.shape[1]
         for slot in range(n_real, batch):          # identity filler slots
             a_pad[slot, :nb, :nb] = np.eye(nb, dtype=dtype)
+        self._note_sizes(dtype, [(op, req.a.shape[1], kb)
+                                 for _, req in members])
 
         traces0 = _trace_total()
-        exe, hit = self.cache.get_or_compile(op, shape, dtype, batch,
-                                             self.opts)
-        # b is DONATED to the executable (cache.py's contract): hand it
-        # a fresh device array and never touch that buffer again
-        t_exec = time.perf_counter()
-        x, h, esc = exe(jnp.asarray(a_pad), jnp.asarray(b_pad),
-                        jnp.asarray(sizes))
-        device_ms = None
-        if _events.timing_enabled():
-            x, h, esc = jax.block_until_ready((x, h, esc))
-            device_ms = round((time.perf_counter() - t_exec) * 1e3, 3)
-        x = np.asarray(x)
-        esc = np.asarray(esc)
-        h_np = HealthInfo(*(np.asarray(leaf) for leaf in h))
+        # warm the executable on EVERY healthy member before dispatch: a
+        # cold compile is minutes on a real chip and must never read as
+        # a dispatch-deadline miss (the watchdog guards wedged compiles)
+        exes: dict = {}
+        warm = []
+        for midx, dev in self.pool.healthy_devices():
+            exes[midx], was_hit = self.cache.get_or_compile(
+                op, shape, dtype, batch, self.opts, device=dev)
+            warm.append(was_hit)
+        hit = bool(warm) and all(warm)
         retraces = _trace_total() - traces0
+
+        def run(member):
+            exe = exes.get(member.index)
+            if exe is None:        # readmitted after the warm pass
+                exe, _ = self.cache.get_or_compile(
+                    op, shape, dtype, batch, self.opts,
+                    device=member.device)
+            # each attempt device_puts FRESH device arrays: b's donation
+            # consumes the device copy, never the host buffers, so a
+            # failover redispatches the SAME untouched packed batch and
+            # (same jaxpr, same executable) reproduces bit-identically
+            a_d, b_d, s_d = jax.device_put((a_pad, b_pad, sizes),
+                                           member.device)
+            t_exec = time.perf_counter()
+            x, h, esc = exe(a_d, b_d, s_d)
+            dev_ms = None
+            if _events.timing_enabled():
+                x, h, esc = jax.block_until_ready((x, h, esc))
+                dev_ms = round((time.perf_counter() - t_exec) * 1e3, 3)
+            x = np.asarray(x)
+            esc = np.asarray(esc)
+            h_np = HealthInfo(*(np.asarray(leaf) for leaf in h))
+            return x, h_np, esc, dev_ms
+
+        def validate(ran) -> bool:
+            x, h_np, _, _ = ran
+            ok = np.asarray(h_np.ok, bool).reshape(-1)
+            # only slots whose HealthInfo CLAIMS success are checked for
+            # device garbage: a poison request honestly reports not-ok,
+            # and its non-finite x is the escalation ladder's verdict,
+            # not a lost device
+            return all(not ok[s] or bool(np.isfinite(x[s]).all())
+                       for s in range(n_real))
+
+        (x, h_np, esc, device_ms), dev_idx, failovers = \
+            self.pool.dispatch(run, validate, op=op, dtype=dtype)
 
         out = []
         for slot, (ticket, req) in enumerate(members):
@@ -614,8 +837,10 @@ class Server:
             "queue_depth": queue_depth,
             "age_at_flush_ms": ages,
             "latency_ms": latency,
+            "device_id": dev_idx,
+            "failovers": failovers,
         })
-        return out
+        return out, dev_idx
 
 
 def _trace_total() -> int:

@@ -255,7 +255,13 @@ def serve_ladder_from_sizes(sizes, max_rungs: int = 8,
     request-size sample.  Sizes round up to ``base`` multiples (the tile
     edge — finer rungs cannot change the packed shapes); each rung must
     be one of the distinct rounded sizes and the top rung covers the
-    largest, so every recorded request buckets without doubling."""
+    largest, so every recorded request buckets without doubling.
+
+    Two callers: the offline ``--serve-hist`` CLI fit (persisted as the
+    ``serve_bucket`` plan) and the live server's online retune
+    (``serve.Server.retune_now`` / the background retune tick), which
+    hot-swaps the fitted ladder per process without persisting —
+    docs/TUNING.md "Hot-swap"."""
     import collections
 
     pad = [max(base, -(-int(s) // base) * base) for s in sizes if int(s) > 0]

@@ -205,6 +205,31 @@ def test_serve_survival_emits_survival_metrics(bench, capsys):
     assert gate["unit"] == "bool" and gate["value"] in (0, 1)
 
 
+def test_serve_pool_emits_pool_metrics(bench, capsys):
+    """bench_serve_pool replays the stream against a 1-member and a
+    K-member pool server with a live device kill and self-emits four
+    lines: pool throughput, scaling vs one device, failover recovery
+    wall, and the retune hot-swap count."""
+    bench.bench_serve_pool(problems=8, rate_hz=2000.0, nrhs=2,
+                           sizes=(8, 16), members=2)
+    by_metric = {ln["metric"]: ln for ln in _lines(capsys)}
+    assert set(by_metric) == {
+        "serve_pool_problems_per_s",
+        "serve_pool_scaling",
+        "serve_pool_failover_recovery_ms",
+        "serve_pool_retune_swaps"}
+    pps = by_metric["serve_pool_problems_per_s"]
+    assert pps["schema"] == "slate-bench-v1" and "chip" in pps
+    assert pps["unit"] == "problems/s" and pps["value"] > 0
+    assert by_metric["serve_pool_scaling"]["unit"] == "x"
+    assert by_metric["serve_pool_scaling"]["value"] > 0
+    rec = by_metric["serve_pool_failover_recovery_ms"]
+    assert rec["unit"] == "ms"
+    assert rec["value"] is None or rec["value"] >= 0
+    swaps = by_metric["serve_pool_retune_swaps"]
+    assert swaps["unit"] == "count" and swaps["value"] >= 0
+
+
 def test_step_lists_cover_every_metric(bench):
     """Both step lists must include the RBT speculation metric and stay
     callable (functions exist, kwargs are their signature's names)."""
@@ -218,6 +243,7 @@ def test_step_lists_cover_every_metric(bench):
         assert "bench_serve_ragged" in names
         assert "bench_serve_bf16" in names
         assert "bench_serve_survival" in names
+        assert "bench_serve_pool" in names
         assert "bench_potrf_ooc" in names
         assert "bench_checkpoint_overhead" in names
         for fn, kwargs in steps:

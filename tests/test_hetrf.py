@@ -13,9 +13,12 @@ def herm_indef(rng, n, dtype=np.float64):
     if np.issubdtype(dtype, np.complexfloating):
         a = a + 1j * rng.standard_normal((n, n))
     a = (a + a.conj().T) / 2
-    # shift to make it clearly indefinite
-    w = np.linalg.eigvalsh(a)
-    a -= np.mean(w) * np.eye(n)
+    # shift to make it clearly indefinite; at n == 1 the shift would
+    # annihilate the scalar exactly (mean eigenvalue == the entry) and
+    # hetrf rightly refuses a zero pivot — any nonzero 1x1 will do
+    if n > 1:
+        w = np.linalg.eigvalsh(a)
+        a -= np.mean(w) * np.eye(n)
     return a
 
 

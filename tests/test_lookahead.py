@@ -45,8 +45,26 @@ def _grid(p, q):
 
 
 def _assert_all_equal(base, out, ctx):
+    """Depth-parity oracle.  Integer/bool leaves (ABFT counters, health
+    scalars) must match EXACTLY on every machine.  Float leaves are
+    bit-identical wherever XLA lowers both depths with the same
+    accumulation order — but depth 0 and depth >= 1 are *different
+    programs*, and the CPU backend's threading/fusion heuristics vary
+    with the host's core count, so on some hosts the trailing updates
+    legitimately differ in the last few ulps (the PR-18 tier-1 triage:
+    the same seeds failed on a 1-core container and pass elsewhere).
+    Exact-first, then a dtype-calibrated 32*eps fallback — tight enough
+    that a real schedule bug (stale panel, wrong tile) still fails."""
     for i, (x, y) in enumerate(zip(base, out)):
-        assert np.array_equal(np.asarray(x), np.asarray(y)), (ctx, i)
+        x, y = np.asarray(x), np.asarray(y)
+        if np.array_equal(x, y):
+            continue
+        assert (np.issubdtype(x.dtype, np.floating)
+                or np.issubdtype(x.dtype, np.complexfloating)), (ctx, i)
+        tol = 32 * float(np.finfo(x.dtype).eps)
+        scale = max(1.0, float(np.max(np.abs(x))))
+        np.testing.assert_allclose(y, x, rtol=tol, atol=tol * scale,
+                                   err_msg=str((ctx, i)))
 
 
 def _summa_args(rng, g, dt, m=18, kk=22, n=14):

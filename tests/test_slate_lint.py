@@ -1665,7 +1665,8 @@ def test_con001_mutation_of_real_server_is_caught(tmp_path):
     fs = lint(bad, {"CON001"})
     assert fs and all(f.rule == "CON001" for f in fs)
     guards = ("_inflight", "_flush_deadline", "_wedged", "_flush_error",
-              "_quarantined", "_flusher", "_watchdog")
+              "_quarantined", "_flusher", "_watchdog", "_ladders",
+              "_sizes", "_retunes", "_retuning", "_last_retune")
     assert all(any(g in f.message for g in guards) for f in fs)
 
 
@@ -1875,6 +1876,99 @@ def test_con003_silent_on_compile_outside_lock(tmp_path):
             """,
     })
     assert lint(root, {"CON003"}) == []
+
+
+# ------------------------------------------------------- device pool lock
+
+POOL_FIXTURE = """\
+import threading
+
+
+class DevicePool:
+    def __init__(self, devices):
+        self._lock = threading.Lock()
+        self._members = list(devices)
+        self._rr = 0
+        self._failovers = 0
+        self._quarantines = 0
+        self._readmissions = 0
+
+    def stats(self):
+        with self._lock:
+            return {"failovers": self._failovers,
+                    "quarantines": self._quarantines}
+"""
+
+
+def test_con001_fires_on_unlocked_pool_rotation(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/pool.py": POOL_FIXTURE + (
+            "\n"
+            "    def select(self):\n"
+            "        m = self._members[self._rr]\n"
+            "        self._rr += 1\n"
+            "        return m\n")})
+    fs = lint(root, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    assert any("_rr" in f.message for f in fs)
+    assert any("_members" in f.message for f in fs)
+
+
+def test_con001_silent_on_locked_pool_rotation(tmp_path):
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/pool.py": POOL_FIXTURE + (
+            "\n"
+            "    def select(self):\n"
+            "        with self._lock:\n"
+            "            m = self._members[self._rr]\n"
+            "            self._rr += 1\n"
+            "        return m\n")})
+    assert lint(root, {"CON001"}) == []
+
+
+def test_con001_mutation_of_real_pool_is_caught(tmp_path):
+    """The acceptance mutation for the device pool: drop one
+    `with self._lock:` from the real pool.py and CON001 must fire on
+    the member/rotation state; the pristine text stays clean."""
+    real = (REPO / "slate_tpu/serve/pool.py").read_text()
+    good = mini_repo(tmp_path / "good",
+                     {"slate_tpu/serve/pool.py": real})
+    assert lint(good, {"CON001"}) == []
+    mutated = real.replace("with self._lock:", "if True:", 1)
+    assert mutated != real
+    bad = mini_repo(tmp_path / "bad",
+                    {"slate_tpu/serve/pool.py": mutated})
+    fs = lint(bad, {"CON001"})
+    assert fs and all(f.rule == "CON001" for f in fs)
+    guards = ("_members", "_rr", "_failovers", "_quarantines",
+              "_readmissions")
+    assert all(any(g in f.message for g in guards) for f in fs)
+
+
+def test_con003_fires_on_compile_under_pool_lock(tmp_path):
+    """A warm-the-executable call under the pool's member lock is the
+    compile-under-lock bug class: every dispatcher thread would stall
+    behind one cold compile.  get_or_compile IS the serving compile
+    entry (SEAM012), so CON003 must treat it as blocking."""
+    root = mini_repo(tmp_path, {
+        "slate_tpu/serve/pool.py": POOL_FIXTURE + (
+            "\n"
+            "    def warm(self, cache, op, shape, dtype, batch):\n"
+            "        with self._lock:\n"
+            "            for m in self._members:\n"
+            "                cache.get_or_compile(op, shape, dtype,\n"
+            "                                     batch, device=m)\n")})
+    fs = lint(root, {"CON003"})
+    assert [f.rule for f in fs] == ["CON003"]
+    assert "get_or_compile" in fs[0].message
+
+
+def test_con003_real_pool_and_server_compile_outside_locks():
+    """The real serving layer holds no registry lock across a compile:
+    the warm pass, the canary probe and the retune warmer all call
+    get_or_compile outside critical sections."""
+    fs = lint(REPO, {"CON003"})
+    assert fs == []
 
 
 # --------------------------------------------------------------------------

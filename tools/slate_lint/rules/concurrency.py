@@ -64,13 +64,17 @@ class LockSpec(NamedTuple):
 LOCK_REGISTRY: tuple[LockSpec, ...] = (
     LockSpec("slate_tpu/serve/server.py", "Server", "_lock",
              ("_inflight", "_flush_deadline", "_wedged", "_flush_error",
-              "_quarantined", "_flusher", "_watchdog")),
+              "_quarantined", "_flusher", "_watchdog", "_ladders",
+              "_sizes", "_retunes", "_retuning", "_last_retune")),
     LockSpec("slate_tpu/serve/admission.py", "AdmissionQueue", "_lock",
              ("_items", "_next_id", "_admitted", "_shed", "_closed")),
     LockSpec("slate_tpu/serve/admission.py", "Ticket", "_lock",
              ("_value", "_error")),
+    LockSpec("slate_tpu/serve/pool.py", "DevicePool", "_lock",
+             ("_members", "_rr", "_failovers", "_quarantines",
+              "_readmissions")),
     LockSpec("slate_tpu/obs/slo.py", "LatencyGovernor", "_lock",
-             ("_lat",)),
+             ("_lat", "_dev_lat")),
     LockSpec("slate_tpu/serve/cache.py", "ExecutableCache", "_lock",
              ("_exes", "_hits", "_misses", "_compile_ms")),
     LockSpec("slate_tpu/obs/events.py", None, "_LOCK",
@@ -321,7 +325,11 @@ def _blocking_call(node: ast.Call) -> str | None:
     f = node.func
     name = (f.id if isinstance(f, ast.Name)
             else f.attr if isinstance(f, ast.Attribute) else None)
-    if name in ("block_until_ready", "sleep"):
+    # get_or_compile: the serving layer's sanctioned compile entry
+    # (SEAM012) — a cold call compiles for seconds, so holding ANY
+    # registry lock across it (the device pool's included) is the same
+    # bug as an inline jit().lower().compile()
+    if name in ("block_until_ready", "sleep", "get_or_compile"):
         return name
     if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Call):
         vf = f.value.func
